@@ -1,0 +1,65 @@
+"""gossipprotocol_tpu — a TPU-native gossip / push-sum convergence framework.
+
+A from-scratch, bulk-synchronous reimagining of the capabilities of the
+reference actor-model simulator (sharwarimarathe/GossipProtocol,
+``Project2/Program.fs``): N network nodes run either the **gossip**
+rumor-spreading protocol or the **push-sum** distributed-averaging protocol
+over a pluggable topology until global convergence, and the framework reports
+wall-clock time to convergence.
+
+Instead of one Akka actor per node exchanging asynchronous messages
+(``Program.fs:36,65-137``), node state lives in dense JAX arrays sharded over
+a TPU device mesh; one *round* advances every node simultaneously via a
+random-neighbor gather + scatter-add (``jax.ops.segment_sum``), driven by
+``lax.while_loop`` with the convergence supervisor's predicate as the loop
+condition (``Program.fs:41-63`` → a ``psum``-reduced streak test).
+
+Layer map (mirrors SURVEY.md §1):
+
+=====  ==============================  ==============================
+Layer  Reference (F#/Akka)             This framework (JAX/TPU)
+=====  ==============================  ==============================
+L5     CLI argv parse                  :mod:`gossipprotocol_tpu.cli`
+L4     topology wiring + seeding       :mod:`gossipprotocol_tpu.topology`
+L3     per-actor protocol handlers     :mod:`gossipprotocol_tpu.protocols`
+L2     scheduler actor (supervisor)    :mod:`gossipprotocol_tpu.engine`
+L1     Akka mailboxes                  :mod:`gossipprotocol_tpu.parallel`
+=====  ==============================  ==============================
+"""
+
+from gossipprotocol_tpu.version import __version__
+
+from gossipprotocol_tpu.topology import (
+    Topology,
+    build_topology,
+    available_topologies,
+)
+from gossipprotocol_tpu.protocols import (
+    GossipState,
+    PushSumState,
+    gossip_init,
+    pushsum_init,
+    make_gossip_round,
+    make_pushsum_round,
+)
+from gossipprotocol_tpu.engine import (
+    RunConfig,
+    RunResult,
+    run_simulation,
+)
+
+__all__ = [
+    "__version__",
+    "Topology",
+    "build_topology",
+    "available_topologies",
+    "GossipState",
+    "PushSumState",
+    "gossip_init",
+    "pushsum_init",
+    "make_gossip_round",
+    "make_pushsum_round",
+    "RunConfig",
+    "RunResult",
+    "run_simulation",
+]

@@ -1,0 +1,3 @@
+from gossipprotocol_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,215 @@
+"""Command-line interface (reference L5, ``Program.fs:30-37``).
+
+The reference's positional surface is preserved exactly:
+
+    python -m gossipprotocol_tpu <num_nodes> <topology> <algorithm>
+
+with ``topology`` ∈ {line, full, 3D, imp3D, erdos_renyi, power_law} and
+``algorithm`` ∈ {gossip, push-sum} (hyphenated, matching the reference's
+match arm ``Program.fs:196-205``; "push_sum"/"pushsum" accepted as
+aliases). Output is format-compatible: the start banner
+("Gossip Starts" / "Push Sum Starts") and the one metric
+``Convergence Time: %f ms`` (``Program.fs:55``).
+
+Beyond the reference (north-star flags, BASELINE.json): ``--backend``,
+``--seed``, ``--threshold``, ``--eps``, ``--streak``, ``--max-rounds``,
+``--semantics``, ``--metrics-out``, ``--checkpoint-dir``, ``--resume``,
+``--fail-fraction/--fail-round``, ``--devices`` (multi-chip sharding),
+``--profile-dir``. Invalid input errors loudly — the reference silently
+no-ops on unknown topologies (``Program.fs:279``) and prints "option
+invalid" on unknown algorithms (``Program.fs:207``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+_ALGO_ALIASES = {
+    "gossip": "gossip",
+    "push-sum": "push-sum",
+    "push_sum": "push-sum",
+    "pushsum": "push-sum",
+    "push sum": "push-sum",
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gossipprotocol_tpu",
+        description="TPU-native gossip / push-sum convergence simulator",
+    )
+    p.add_argument("num_nodes", type=int)
+    p.add_argument("topology", type=str)
+    p.add_argument("algorithm", type=str)
+    p.add_argument("--backend", default="auto",
+                   help="jax platform: auto|tpu|cpu (auto = jax default)")
+    p.add_argument("--devices", type=int, default=1,
+                   help="shard node state over this many devices (mesh axis 'nodes')")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--threshold", type=int, default=10,
+                   help="gossip: hearings to converge (README.md:2)")
+    p.add_argument("--eps", type=float, default=1e-10,
+                   help="push-sum: |Δ(s/w)| tolerance (Program.fs:116)")
+    p.add_argument("--streak", type=int, default=3,
+                   help="push-sum: consecutive small-delta rounds (Program.fs:121)")
+    p.add_argument("--semantics", choices=["intended", "reference"],
+                   default="intended")
+    p.add_argument("--value-mode", choices=["scaled", "index"], default="scaled",
+                   help="push-sum init: i/N (TPU-safe) or the reference's s_i=i")
+    p.add_argument("--no-keep-alive", action="store_true",
+                   help="disable the Actor2-style rumor keep-alive (Program.fs:141-163)")
+    p.add_argument("--max-rounds", type=int, default=1_000_000)
+    p.add_argument("--chunk-rounds", type=int, default=512)
+    p.add_argument("--seed-node", type=int, default=None)
+    p.add_argument("--avg-degree", type=float, default=8.0,
+                   help="erdos_renyi mean degree")
+    p.add_argument("--attach", type=int, default=4,
+                   help="power_law edges per new node (BA m)")
+    p.add_argument("--metrics-out", type=str, default=None,
+                   help="JSONL file for per-chunk metrics records")
+    p.add_argument("--checkpoint-dir", type=str, default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="chunks between checkpoints (0 = off)")
+    p.add_argument("--resume", type=str, default=None,
+                   help="checkpoint file (or dir) to resume from")
+    p.add_argument("--fail-fraction", type=float, default=0.0,
+                   help="fault injection: kill this fraction of nodes")
+    p.add_argument("--fail-round", type=int, default=0,
+                   help="round at which the failures strike")
+    p.add_argument("--profile-dir", type=str, default=None,
+                   help="emit a jax.profiler trace here")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress everything except the convergence metric")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import os
+
+    import jax
+
+    if args.backend != "auto":
+        # This image's sitecustomize pre-imports jax, so flipping
+        # JAX_PLATFORMS here would be a silent no-op. Select the backend by
+        # pinning the default device instead — effective post-import.
+        try:
+            backend_devices = jax.devices(args.backend)
+        except RuntimeError as e:
+            print(f"backend {args.backend!r} unavailable: {e}", file=sys.stderr)
+            return 2
+        jax.config.update("jax_default_device", backend_devices[0])
+
+    from gossipprotocol_tpu.engine import RunConfig, run_simulation, resume_simulation
+    from gossipprotocol_tpu.topology import build_topology
+    from gossipprotocol_tpu.utils import checkpoint as ckpt
+    from gossipprotocol_tpu.utils import faults
+    from gossipprotocol_tpu.utils.metrics import (
+        JsonlMetricsWriter,
+        print_convergence_time,
+        print_start_banner,
+    )
+    from gossipprotocol_tpu.utils.profiling import maybe_trace
+
+    algo = _ALGO_ALIASES.get(args.algorithm.lower())
+    if algo is None:
+        print(f"option invalid: unknown algorithm {args.algorithm!r} "
+              f"(valid: gossip, push-sum)", file=sys.stderr)
+        return 2
+
+    try:
+        topo = build_topology(
+            args.topology, args.num_nodes,
+            seed=args.seed, avg_degree=args.avg_degree, m=args.attach,
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if not args.quiet and topo.num_nodes != args.num_nodes:
+        print(f"note: {args.topology} rounds {args.num_nodes} up to "
+              f"{topo.num_nodes} nodes (Program.fs:239-240 semantics)")
+
+    writer = JsonlMetricsWriter(args.metrics_out) if args.metrics_out else None
+
+    fault_plan = None
+    if args.fail_fraction > 0:
+        fault_plan = faults.random_fault_plan(
+            topo.num_nodes, args.fail_fraction, args.fail_round, seed=args.seed
+        )
+
+    cfg = RunConfig(
+        algorithm=algo,
+        seed=args.seed,
+        threshold=args.threshold,
+        eps=args.eps,
+        streak_target=args.streak,
+        keep_alive=not args.no_keep_alive,
+        semantics=args.semantics,
+        value_mode=args.value_mode,
+        max_rounds=args.max_rounds,
+        chunk_rounds=args.chunk_rounds,
+        seed_node=args.seed_node,
+        metrics_callback=writer,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
+        fault_plan=fault_plan,
+    )
+
+    if not args.quiet:
+        print_start_banner(algo)
+
+    state = None
+    if args.resume:
+        path = args.resume
+        if os.path.isdir(path):
+            path = ckpt.latest(path)
+            if path is None:
+                print(f"no checkpoint found in {args.resume}", file=sys.stderr)
+                return 2
+        state, meta = ckpt.load(path)
+        # a checkpoint from a different experiment would "resume" into a
+        # plausible-but-wrong run — validate before continuing
+        problems = []
+        if meta.get("algorithm") != algo:
+            problems.append(f"algorithm {meta.get('algorithm')!r} != {algo!r}")
+        if meta.get("topology") not in (None, topo.kind):
+            problems.append(f"topology {meta.get('topology')!r} != {topo.kind!r}")
+        if state.alive.shape[0] != topo.num_nodes:
+            problems.append(
+                f"checkpoint has {state.alive.shape[0]} nodes, run has {topo.num_nodes}"
+            )
+        if problems:
+            print("checkpoint mismatch: " + "; ".join(problems), file=sys.stderr)
+            return 2
+
+    with maybe_trace(args.profile_dir):
+        if args.devices > 1:
+            from gossipprotocol_tpu.parallel import run_simulation_sharded
+
+            result = run_simulation_sharded(
+                topo, cfg, num_devices=args.devices, initial_state=state,
+                backend=None if args.backend == "auto" else args.backend,
+            )
+        elif state is not None:
+            result = resume_simulation(topo, cfg, state)
+        else:
+            result = run_simulation(topo, cfg)
+
+    if writer:
+        writer.close()
+
+    print_convergence_time(result.wall_ms)
+    if not args.quiet:
+        print(f"rounds: {result.rounds}  converged: {result.converged}  "
+              f"nodes: {result.num_nodes}  compile: {result.compile_ms:.1f} ms  "
+              f"devices: {args.devices}  backend: {jax.default_backend()}")
+        err = result.estimate_error
+        if err is not None:
+            print(f"push-sum max |s/w - mean| = {err:.3e}")
+    return 0 if result.converged else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

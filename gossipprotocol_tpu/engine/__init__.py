@@ -1,0 +1,21 @@
+from gossipprotocol_tpu.engine.driver import (
+    RunConfig,
+    RunResult,
+    run_simulation,
+    resume_simulation,
+    build_protocol,
+    make_chunk_runner,
+    pick_seed_node,
+    ALGORITHMS,
+)
+
+__all__ = [
+    "RunConfig",
+    "RunResult",
+    "run_simulation",
+    "resume_simulation",
+    "build_protocol",
+    "make_chunk_runner",
+    "pick_seed_node",
+    "ALGORITHMS",
+]

@@ -1,0 +1,368 @@
+"""Experiment driver + convergence supervisor.
+
+Replaces the reference's ``scheduler`` actor (``Program.fs:41-63``) and the
+experiment-driver match block (``Program.fs:178-279``). The supervisor's
+"count Alerts until counter = nodes" becomes the loop condition of a
+``lax.while_loop``; the stopwatch around the whole run (``Program.fs:35,
+194,54``) becomes a host-side wall-clock around the jitted rounds, with
+compile time measured and excluded (reported separately — the reference
+JIT-compiles nothing, so folding XLA compile into the metric would compare
+apples to oranges).
+
+The loop is *chunked*: one jitted call advances rounds until a runtime
+``round_limit`` (or global convergence, whichever first), then the host
+reads the converged count, emits a structured metrics record (SURVEY.md
+§5.5), applies any scheduled fault injections (§5.3), and optionally
+checkpoints (§5.4). The limit is ``min(next chunk boundary, max_rounds,
+next scheduled fault)``, so fault rounds and round budgets are honored
+exactly. State buffers are donated so the update stays in-place on device;
+topology arrays, the PRNG key, and the limit are runtime arguments, so one
+compiled executable serves every same-shape topology, seed, and budget.
+
+The same host loop (`_drive`) drives both the single-chip and the
+``shard_map`` engines — the engines only differ in how one chunk step is
+issued.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossipprotocol_tpu.protocols import (
+    GossipState,
+    PushSumState,
+    gossip_done,
+    gossip_init,
+    pushsum_done,
+    pushsum_init,
+)
+from gossipprotocol_tpu.protocols.gossip import gossip_round
+from gossipprotocol_tpu.protocols.pushsum import pushsum_round
+from gossipprotocol_tpu.protocols.sampling import device_topology
+from gossipprotocol_tpu.topology.base import Topology
+
+ALGORITHMS = ("gossip", "push-sum")
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Everything the reference reads from argv plus the knobs it hardcodes.
+
+    ``semantics="reference"`` reproduces the reference's accidental rules
+    (gossip threshold 11, push-sum streak-from-1 always-zero delta) for
+    baseline curve matching; ``"intended"`` (default) implements the rules
+    the README/report claim (SURVEY.md §2.4).
+    """
+
+    algorithm: str = "gossip"
+    seed: int = 0
+    threshold: int = 10            # gossip hits to converge (README.md:2)
+    eps: float = 1e-10             # push-sum |Δ(s/w)| tolerance (Program.fs:116)
+    streak_target: int = 3         # consecutive small-delta rounds (Program.fs:121)
+    keep_alive: bool = True        # bulk-sync analogue of Actor2 (Program.fs:141-163)
+    semantics: str = "intended"    # "intended" | "reference"
+    value_mode: str = "scaled"     # push-sum init: "scaled" (i/N) | "index" (i)
+    dtype: Any = jnp.float32
+    max_rounds: int = 1_000_000
+    chunk_rounds: int = 512        # rounds per jitted call / metrics cadence
+    seed_node: Optional[int] = None  # gossip start node; None = random (Program.fs:193)
+    # aux subsystems
+    metrics_callback: Optional[Callable[[dict], None]] = None
+    checkpoint_every: int = 0      # chunks between checkpoints; 0 = off
+    checkpoint_dir: Optional[str] = None
+    fault_plan: Optional[dict] = None  # {round:int -> node_ids} injected kills
+
+    def __post_init__(self):
+        if self.algorithm not in ALGORITHMS:
+            raise ValueError(
+                f"unknown algorithm {self.algorithm!r}; valid: {ALGORITHMS} "
+                "(the reference prints 'option invalid', Program.fs:207)"
+            )
+        if self.semantics not in ("intended", "reference"):
+            raise ValueError("semantics must be 'intended' or 'reference'")
+
+
+@dataclasses.dataclass
+class RunResult:
+    converged: bool
+    rounds: int
+    wall_ms: float            # convergence time, excluding compile
+    compile_ms: float
+    num_nodes: int
+    algorithm: str
+    final_state: Any
+    metrics: List[dict]
+    checkpoints: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def estimate_error(self) -> Optional[float]:
+        """Push-sum: max |s/w − achievable mean| over healthy nodes.
+
+        The reference mean is computed over *healthy* rows only: a dead
+        node's mass is stranded (SURVEY.md §5.3 semantics), so the mean the
+        survivors can reach is sum_alive(s)/sum_alive(w).
+        """
+        st = self.final_state
+        if not isinstance(st, PushSumState):
+            return None
+        ratio = np.asarray(st.ratio, dtype=np.float64)
+        alive = np.asarray(st.alive)
+        if not alive.any():
+            return None
+        s = np.asarray(st.s, np.float64)[alive]
+        w = np.asarray(st.w, np.float64)[alive]
+        true_mean = float(s.sum() / w.sum())
+        return float(np.abs(ratio[alive] - true_mean).max())
+
+
+def pick_seed_node(num_nodes: int, seed: int) -> int:
+    """Random gossip start node (reference: ``Random().Next(0, nodes)``,
+    ``Program.fs:193``) — derived from the run seed, reproducible."""
+    return int(np.random.default_rng(seed ^ 0x5EED).integers(0, num_nodes))
+
+
+def initial_alive(topo: Topology) -> Optional[jax.Array]:
+    """Healthy-at-birth mask: isolated (degree-0) nodes — statistically
+    expected in large Erdős–Rényi graphs — can never hear anything, so
+    they are excluded from the supervisor's predicate up front (same
+    mechanism as fault-injected nodes). None = everyone healthy."""
+    if topo.implicit_full:
+        return None
+    deg = topo.degree
+    if (deg > 0).all():
+        return None
+    return jnp.asarray(deg > 0)
+
+
+def build_protocol(topo: Topology, cfg: RunConfig, num_rows: Optional[int] = None):
+    """(init_state, round_core(state, nbrs, key, ...), done_fn, extra_stats).
+
+    ``num_rows`` > num_nodes pads the state with phantom rows (dead and
+    converged — invisible to the protocol and the predicate) for sharding.
+    ``extra_stats`` (or None) adds protocol-specific scalars to the chunk
+    stats — gossip reports its spreader count for stall detection.
+    """
+    ref = cfg.semantics == "reference"
+    n = topo.num_nodes
+    rows = num_rows or n
+    if cfg.algorithm == "gossip":
+        seed_node = (
+            pick_seed_node(n, cfg.seed) if cfg.seed_node is None else cfg.seed_node
+        )
+        # reference converges on the 11th hearing (Program.fs:91-92); the
+        # intended rule is 10 (README.md:2)
+        threshold = cfg.threshold + 1 if ref else cfg.threshold
+        state = gossip_init(rows, seed_node)
+        core = partial(
+            gossip_round, n=n, threshold=threshold, keep_alive=cfg.keep_alive
+        )
+        done_fn = gossip_done
+        keep_alive = cfg.keep_alive
+        extra_stats = lambda s: {  # noqa: E731
+            "spreading": gossip_spreading_count(s, keep_alive)
+        }
+    else:
+        state = pushsum_init(
+            rows, value_mode=cfg.value_mode, dtype=cfg.dtype, reference_semantics=ref
+        )
+        core = partial(
+            pushsum_round,
+            n=n,
+            eps=cfg.eps,
+            streak_target=cfg.streak_target,
+            reference_semantics=ref,
+        )
+        done_fn = pushsum_done
+        extra_stats = None
+
+    alive0 = initial_alive(topo)
+    if alive0 is not None:
+        if rows > n:
+            alive0 = jnp.concatenate([alive0, jnp.zeros(rows - n, bool)])
+        state = state._replace(alive=state.alive & alive0)
+    if rows > n:
+        pad_dead = jnp.arange(rows) >= n
+        state = state._replace(
+            alive=state.alive & ~pad_dead,
+            converged=state.converged | pad_dead,
+        )
+    return state, core, done_fn, extra_stats
+
+
+def gossip_spreading_count(state: GossipState, keep_alive: bool) -> jax.Array:
+    """Nodes still able to deliver a hit. Zero while unconverged means the
+    rumor is dead (e.g. the seed node was fault-killed, or keep_alive=False
+    let every spreader go silent — the reference's liveness hole) and the
+    run can never progress: the driver stalls out instead of grinding to
+    max_rounds."""
+    heard = (state.counts >= 1) & state.alive
+    if not keep_alive:
+        heard = heard & ~state.converged
+    return jnp.sum(heard.astype(jnp.int32))
+
+
+def chunk_stats(state, done_fn) -> dict:
+    """On-device summary scalars for one chunk (SURVEY.md §5.5 metrics).
+
+    Computed inside the jitted chunk call and fetched in a *single* host
+    transfer — on a tunneled TPU each separate ``int(...)`` costs a
+    round-trip, which would otherwise dominate small runs' wall-clock.
+    Phantom/dead rows are excluded by construction (``alive`` is False
+    there).
+    """
+    rec = {
+        "round": state.round,
+        "done": done_fn(state),
+        "converged": jnp.sum((state.converged & state.alive).astype(jnp.int32)),
+        "alive": jnp.sum(state.alive.astype(jnp.int32)),
+    }
+    if isinstance(state, PushSumState):
+        big = jnp.asarray(jnp.inf, state.ratio.dtype)
+        rec["ratio_min"] = jnp.min(jnp.where(state.alive, state.ratio, big))
+        rec["ratio_max"] = jnp.max(jnp.where(state.alive, state.ratio, -big))
+    return rec
+
+
+def stats_with_extra(state, done_fn, extra_stats) -> dict:
+    rec = chunk_stats(state, done_fn)
+    if extra_stats is not None:
+        rec.update(extra_stats(state))
+    return rec
+
+
+def make_chunk_runner(round_core, done_fn, extra_stats=None):
+    """jitted ``(state, nbrs, base_key, round_limit) -> (state, stats)``:
+    advance rounds until global convergence or ``state.round ==
+    round_limit``. The supervisor predicate is evaluated in the loop
+    condition — the reference's flow 3.4 folded into cond_fun — and again
+    in the returned stats so the host loop needs one fetch per chunk."""
+
+    def chunk(state, nbrs, base_key, round_limit):
+        def body(s):
+            return round_core(s, nbrs, base_key)
+
+        def cond(s):
+            return jnp.logical_and(~done_fn(s), s.round < round_limit)
+
+        final = jax.lax.while_loop(cond, body, state)
+        return final, stats_with_extra(final, done_fn, extra_stats)
+
+    return jax.jit(chunk, donate_argnums=0)
+
+
+def _drive(
+    topo: Topology,
+    cfg: RunConfig,
+    state,
+    step: Callable[[Any, int], Any],
+    done_fn,
+    compile_ms: float,
+    trim: Callable[[Any], Any] = lambda s: s,
+) -> RunResult:
+    """Shared host loop for the single-chip and sharded engines.
+
+    ``step(state, round_limit) -> (state, stats)`` advances the state on
+    device and returns on-device summary scalars (one host fetch per
+    chunk); ``trim`` drops padding rows before anything user-visible
+    (checkpoints, the returned final state).
+    """
+    from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
+
+    fault_plan = {int(k): v for k, v in (cfg.fault_plan or {}).items()}
+    metrics: List[dict] = []
+    checkpoints: List[str] = []
+    chunk_i = 0
+    cur_round = 0
+    done = False
+
+    t0 = time.perf_counter()
+    while True:
+        if cur_round >= cfg.max_rounds:
+            break
+        # fault injection (SURVEY.md §5.3): strike everything due; the
+        # round_limit below guarantees we stop exactly at the next
+        # scheduled fault so none can be skipped
+        for r in [r for r in fault_plan if r <= cur_round]:
+            ids = np.asarray(fault_plan.pop(r), dtype=np.int64)
+            state = state._replace(alive=state.alive.at[ids].set(False))
+
+        next_fault = min(fault_plan, default=cfg.max_rounds)
+        round_limit = min(cur_round + cfg.chunk_rounds, cfg.max_rounds, next_fault)
+
+        state, stats = step(state, round_limit)
+        chunk_i += 1
+
+        host = jax.device_get(stats)  # the one blocking transfer per chunk
+        cur_round = int(host.pop("round"))
+        done = bool(host.pop("done"))
+        rec = {"round": cur_round, **{k: v.item() for k, v in host.items()}}
+        stalled = not done and rec.get("spreading") == 0
+        if stalled:
+            # gossip liveness failure: no node can ever deliver another hit
+            # (seed fault-killed, or keep_alive=False silenced everyone —
+            # the reference's Actor2 hole); grinding to max_rounds is
+            # pointless
+            rec["stalled"] = True
+        metrics.append(rec)
+        if cfg.metrics_callback:
+            cfg.metrics_callback(rec)
+        if cfg.checkpoint_every and cfg.checkpoint_dir and (
+            chunk_i % cfg.checkpoint_every == 0
+        ):
+            checkpoints.append(
+                ckpt_mod.save(cfg.checkpoint_dir, trim(state), cfg, topo.kind)
+            )
+        if done or stalled:
+            break
+    jax.block_until_ready(state)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+
+    return RunResult(
+        converged=done,
+        rounds=cur_round,
+        wall_ms=wall_ms,
+        compile_ms=compile_ms,
+        num_nodes=topo.num_nodes,
+        algorithm=cfg.algorithm,
+        final_state=jax.device_get(trim(state)),
+        metrics=metrics,
+        checkpoints=checkpoints,
+    )
+
+
+def run_simulation(
+    topo: Topology, cfg: RunConfig, initial_state=None
+) -> RunResult:
+    """Build, compile, and drive the configured protocol to convergence.
+
+    ``initial_state`` resumes from a checkpoint (SURVEY.md §5.4).
+    """
+    state, round_core, done_fn, extra_stats = build_protocol(topo, cfg)
+    if initial_state is not None:
+        # copy: the chunk runner donates its input buffers, and consuming
+        # the caller's arrays in-place would be a surprising API
+        state = jax.tree.map(jnp.array, initial_state)
+    nbrs = device_topology(topo)
+    base_key = jax.random.key(cfg.seed)
+    runner = make_chunk_runner(round_core, done_fn, extra_stats)
+
+    t0 = time.perf_counter()
+    compiled = runner.lower(state, nbrs, base_key, jnp.int32(0)).compile()
+    compile_ms = (time.perf_counter() - t0) * 1e3
+
+    def step(s, round_limit):
+        return compiled(s, nbrs, base_key, jnp.int32(round_limit))
+
+    return _drive(topo, cfg, state, step, done_fn, compile_ms)
+
+
+def resume_simulation(topo: Topology, cfg: RunConfig, state) -> RunResult:
+    """Continue a run from a checkpointed state (SURVEY.md §5.4)."""
+    return run_simulation(topo, cfg, initial_state=state)

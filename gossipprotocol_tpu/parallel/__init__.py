@@ -1,0 +1,21 @@
+from gossipprotocol_tpu.parallel.mesh import (
+    NODES_AXIS,
+    make_mesh,
+    node_sharding,
+    padded_size,
+    replicated,
+)
+from gossipprotocol_tpu.parallel.sharded import (
+    make_sharded_chunk_runner,
+    run_simulation_sharded,
+)
+
+__all__ = [
+    "NODES_AXIS",
+    "make_mesh",
+    "node_sharding",
+    "padded_size",
+    "replicated",
+    "make_sharded_chunk_runner",
+    "run_simulation_sharded",
+]

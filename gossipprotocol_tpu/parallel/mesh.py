@@ -1,0 +1,53 @@
+"""Device mesh utilities.
+
+The reference's "distributed" layer is an in-process actor runtime with its
+remote transport never configured (SURVEY.md §2.8: Akka.Remote/DotNetty are
+dead weight). The TPU-native communication backend is real: a 1-D
+``jax.sharding.Mesh`` over the ``"nodes"`` axis, with node state sharded
+row-wise and XLA collectives (``psum``, ``psum_scatter``, ``all_gather``)
+riding ICI within a host and DCN across hosts. ``jax.distributed`` /
+multi-process meshes slot in here unchanged: the mesh just spans every
+process's devices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODES_AXIS = "nodes"
+
+
+def make_mesh(
+    num_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D mesh over ``num_devices`` (default: all visible) devices."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            if num_devices > len(devices):
+                raise ValueError(
+                    f"requested {num_devices} devices, only {len(devices)} visible"
+                )
+            devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (NODES_AXIS,))
+
+
+def node_sharding(mesh: Mesh) -> NamedSharding:
+    """Row-sharded placement for a [N, ...] node-state array."""
+    return NamedSharding(mesh, P(NODES_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def padded_size(n: int, num_shards: int) -> int:
+    """n rounded up to a multiple of the shard count (phantom rows are
+    dead-and-converged so they never influence the protocol)."""
+    return ((n + num_shards - 1) // num_shards) * num_shards

@@ -1,0 +1,262 @@
+"""Multi-chip engine: the round loop under ``shard_map``.
+
+Communication design (SURVEY.md §5.8, BASELINE.json north star): node state
+shards row-wise over a 1-D ``"nodes"`` mesh; the CSR adjacency is
+replicated (read-only shared structure). Per round, each device
+
+  1. draws targets for its local rows (draws key on *global* node ids, so
+     trajectories are sharding-invariant — bitwise equal to single-chip),
+  2. scatter-adds its contributions into a full-length partial vector
+     (local ``segment_sum``), and
+  3. ``psum_scatter``\\ s the partials over ICI so each device receives
+     exactly its own row block — the all-reduce+slice fused into one
+     reduce-scatter, the collective actually owed here (SURVEY.md §1 maps
+     the reference's Akka mailbox delivery to exactly this).
+
+The supervisor's global predicate ("counter = nodes", ``Program.fs:53``)
+is a ``psum`` of per-shard unconverged counts, computed in the loop body
+and carried into ``while_loop``'s cond so every shard stays in lockstep
+(SURVEY.md §7 hard part e).
+
+Padding: N rounds up to a multiple of the shard count; phantom rows are
+born dead (``alive=False``) and excluded from the predicate, never drawn
+as targets (no real node's neighbor list points at them), and trimmed from
+everything user-visible.
+
+The host loop (faults, metrics, checkpoints, round budget) is the same
+``engine.driver._drive`` the single-chip engine uses — the engines differ
+only in how one chunk step is issued.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gossipprotocol_tpu.engine.driver import (
+    RunConfig,
+    RunResult,
+    _drive,
+    build_protocol,
+)
+from gossipprotocol_tpu.parallel.mesh import NODES_AXIS, make_mesh, padded_size
+from gossipprotocol_tpu.protocols.gossip import gossip_round_core
+from gossipprotocol_tpu.protocols.pushsum import pushsum_round_core
+from gossipprotocol_tpu.protocols.sampling import device_topology
+from gossipprotocol_tpu.topology.base import Topology
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def _sharded_core(topo: Topology, cfg: RunConfig):
+    """The round-core factory matching build_protocol's parameters but
+    using the injectable-scatter cores (collective scatter plugged in by
+    the chunk body)."""
+    ref = cfg.semantics == "reference"
+    n = topo.num_nodes
+    if cfg.algorithm == "gossip":
+        return partial(
+            gossip_round_core,
+            n=n,
+            threshold=cfg.threshold + 1 if ref else cfg.threshold,
+            keep_alive=cfg.keep_alive,
+        )
+    return partial(
+        pushsum_round_core,
+        n=n,
+        eps=cfg.eps,
+        streak_target=cfg.streak_target,
+        reference_semantics=ref,
+    )
+
+
+def _state_specs(state):
+    """PartitionSpec pytree: [N]-arrays shard over "nodes", scalars replicate."""
+    return jax.tree.map(lambda x: P(NODES_AXIS) if jnp.ndim(x) >= 1 else P(), state)
+
+
+def pad_state(state, n_padded: int):
+    """Pad a trimmed (real-rows) state with phantom rows: dead, converged,
+    zero mass — invisible to protocol and predicate."""
+    n = int(state.alive.shape[0])
+    if n == n_padded:
+        return state
+    extra = n_padded - n
+
+    def pad(name, x):
+        if jnp.ndim(x) == 0:
+            return x
+        if name == "converged":
+            fill = jnp.ones(extra, x.dtype)
+        else:  # alive -> False; counts/s/w/ratio/streak -> 0
+            fill = jnp.zeros(extra, x.dtype)
+        return jnp.concatenate([x, fill])
+
+    return type(state)(*(pad(f, v) for f, v in zip(type(state)._fields, state)))
+
+
+def make_sharded_chunk_runner(topo: Topology, cfg: RunConfig, mesh: Mesh):
+    """jitted ``(state, nbrs, seed, round_limit) -> state`` advancing one
+    chunk under shard_map. Returns (runner, initial padded+placed state,
+    placed nbrs, done_fn)."""
+    n = topo.num_nodes
+    num_shards = int(mesh.devices.size)
+    n_padded = padded_size(n, num_shards)
+    local_n = n_padded // num_shards
+
+    state0, _, done_fn, _ = build_protocol(topo, cfg, num_rows=n_padded)
+    core = _sharded_core(topo, cfg)
+    is_pushsum = cfg.algorithm != "gossip"
+
+    def chunk_local(state_l, nbrs, seed, round_limit):
+        base_key = jax.random.key(seed)
+        shard = jax.lax.axis_index(NODES_AXIS)
+        gids = shard * local_n + jnp.arange(local_n, dtype=jnp.int32)
+        # faults only strike between chunks, so the global aliveness mask is
+        # loop-invariant within a chunk: gather it once
+        alive_g = jax.lax.all_gather(state_l.alive, NODES_AXIS, tiled=True)
+
+        def scatter1(v, t):
+            full = jax.ops.segment_sum(v, t, num_segments=n_padded)
+            return jax.lax.psum_scatter(
+                full, NODES_AXIS, scatter_dimension=0, tiled=True
+            )
+
+        def scatter2(a, b, t):
+            full = jax.ops.segment_sum(
+                jnp.stack([a, b], axis=1), t, num_segments=n_padded
+            )
+            loc = jax.lax.psum_scatter(
+                full, NODES_AXIS, scatter_dimension=0, tiled=True
+            )
+            return loc[:, 0], loc[:, 1]
+
+        if is_pushsum:
+            round_fn = partial(
+                core, nbrs=nbrs, base_key=base_key, gids=gids,
+                scatter=scatter2, alive_global=alive_g,
+            )
+        else:
+            round_fn = partial(
+                core, nbrs=nbrs, base_key=base_key, gids=gids, scatter=scatter1,
+            )
+
+        def global_done(s):
+            unconv = jnp.sum((~s.converged & s.alive).astype(jnp.int32))
+            return jax.lax.psum(unconv, NODES_AXIS) == 0
+
+        def body(carry):
+            s, _ = carry
+            s = round_fn(s)
+            return s, global_done(s)
+
+        def cond(carry):
+            s, done = carry
+            return jnp.logical_and(~done, s.round < round_limit)
+
+        final, done = jax.lax.while_loop(
+            cond, body, (state_l, global_done(state_l))
+        )
+        # replicated on-device stats: one host fetch per chunk (mirrors
+        # engine.driver.chunk_stats, with psum/pmin/pmax reductions)
+        stats = {
+            "round": final.round,
+            "done": done,
+            "converged": jax.lax.psum(
+                jnp.sum((final.converged & final.alive).astype(jnp.int32)),
+                NODES_AXIS,
+            ),
+            "alive": jax.lax.psum(
+                jnp.sum(final.alive.astype(jnp.int32)), NODES_AXIS
+            ),
+        }
+        if is_pushsum:
+            big = jnp.asarray(jnp.inf, final.ratio.dtype)
+            stats["ratio_min"] = jax.lax.pmin(
+                jnp.min(jnp.where(final.alive, final.ratio, big)), NODES_AXIS
+            )
+            stats["ratio_max"] = jax.lax.pmax(
+                jnp.max(jnp.where(final.alive, final.ratio, -big)), NODES_AXIS
+            )
+        else:
+            from gossipprotocol_tpu.engine.driver import gossip_spreading_count
+
+            stats["spreading"] = jax.lax.psum(
+                gossip_spreading_count(final, cfg.keep_alive), NODES_AXIS
+            )
+        return final, stats
+
+    specs = _state_specs(state0)
+    nbrs = device_topology(topo)
+    nbrs_specs = jax.tree.map(lambda _: P(), nbrs)
+
+    stats_fields = ["round", "done", "converged", "alive"]
+    if cfg.algorithm != "gossip":
+        stats_fields += ["ratio_min", "ratio_max"]
+    else:
+        stats_fields += ["spreading"]
+    stats_specs = {k: P() for k in stats_fields}
+    sm = shard_map(
+        chunk_local,
+        mesh=mesh,
+        in_specs=(specs, nbrs_specs, P(), P()),
+        out_specs=(specs, stats_specs),
+        check_vma=False,
+    )
+    runner = jax.jit(sm, donate_argnums=0)
+
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    state0 = jax.device_put(state0, shardings)
+    if nbrs is not None:
+        nbrs = jax.device_put(nbrs, NamedSharding(mesh, P()))
+    return runner, state0, nbrs, done_fn, shardings
+
+
+def run_simulation_sharded(
+    topo: Topology,
+    cfg: RunConfig,
+    num_devices: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+    initial_state=None,
+    backend: Optional[str] = None,
+) -> RunResult:
+    """Multi-chip ``run_simulation``: same semantics, same trajectories
+    (sharding-invariant PRNG), state sharded over the mesh.
+
+    ``initial_state`` resumes from a (trimmed) checkpoint: it is re-padded
+    to the mesh and takes over from its recorded round.
+    """
+    if mesh is None:
+        devices = jax.devices(backend) if backend else None
+        mesh = make_mesh(num_devices, devices=devices)
+    n = topo.num_nodes
+    n_padded = padded_size(n, int(mesh.devices.size))
+
+    runner, state, nbrs, done_fn, shardings = make_sharded_chunk_runner(
+        topo, cfg, mesh
+    )
+    if initial_state is not None:
+        state = jax.device_put(pad_state(initial_state, n_padded), shardings)
+    seed = jnp.int32(cfg.seed)
+
+    t0 = time.perf_counter()
+    compiled = runner.lower(state, nbrs, seed, jnp.int32(0)).compile()
+    compile_ms = (time.perf_counter() - t0) * 1e3
+
+    def step(s, round_limit):
+        return compiled(s, nbrs, seed, jnp.int32(round_limit))
+
+    def trim(s):
+        return jax.tree.map(lambda x: x[:n] if jnp.ndim(x) >= 1 else x, s)
+
+    return _drive(topo, cfg, state, step, done_fn, compile_ms, trim=trim)

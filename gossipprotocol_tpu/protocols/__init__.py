@@ -1,0 +1,26 @@
+from gossipprotocol_tpu.protocols.state import (
+    GossipState,
+    PushSumState,
+    gossip_init,
+    pushsum_init,
+)
+from gossipprotocol_tpu.protocols.gossip import make_gossip_round, gossip_done
+from gossipprotocol_tpu.protocols.pushsum import (
+    make_pushsum_round,
+    pushsum_done,
+    mass,
+)
+from gossipprotocol_tpu.protocols.sampling import make_neighbor_sampler
+
+__all__ = [
+    "GossipState",
+    "PushSumState",
+    "gossip_init",
+    "pushsum_init",
+    "make_gossip_round",
+    "gossip_done",
+    "make_pushsum_round",
+    "pushsum_done",
+    "mass",
+    "make_neighbor_sampler",
+]

@@ -1,0 +1,181 @@
+"""Bulk-synchronous push-sum distributed averaging.
+
+Reference semantics (``MainPushSum`` handler, ``Program.fs:101-131``): a
+node accumulates an incoming ``(s, w)`` pair, checks how much its estimate
+``s/w`` moved, halves its pair and forwards one half to a random neighbor;
+after converging it relays incoming pairs unchanged. Because each handler
+emits exactly one message, the reference degenerates into a single-token
+random walk (SURVEY.md §2.4.2), and its convergence test is broken: state
+is committed *before* the delta is computed (``Program.fs:109-114``), so
+the delta is always zero and a node "converges" on its 2nd message.
+
+This module implements the *intended* protocol — the capability the
+reference claims: every round, **every** node halves its ``(s, w)``, keeps
+one half, and scatter-adds the other half to one uniform-random neighbor.
+Mass is conserved exactly (Σs, Σw invariant — a property the reference
+could never test), and per-node estimates ``s/w`` converge to the mean of
+the initial values. The convergence predicate is the reference's intended
+one: ``|Δ(s/w)| <= eps`` for ``streak_target`` consecutive rounds
+(``Program.fs:116-123`` minus the commit-before-compare bug). Converged
+nodes keep participating — the bulk-synchronous analogue of the
+reference's post-convergence relay (``Program.fs:129-131``) — so the
+protocol keeps mixing until the supervisor stops the world.
+
+``reference_semantics=True`` reproduces the reference's accidental
+predicate (delta treated as always-zero: the streak increments on every
+round with incoming mass, and the counter starts at 1) for curve-matching
+against the F# baseline.
+
+Fault injection: a dead node neither sends nor receives; a sender whose
+drawn target is dead keeps its half (sender-side aliveness check, the
+analogue of ``Program.fs:87``'s dict lookup) — mass stays conserved among
+healthy nodes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu.protocols.sampling import (
+    CSRNeighbors,
+    device_topology,
+    sample_neighbors,
+)
+from gossipprotocol_tpu.protocols.state import PushSumState
+from gossipprotocol_tpu.topology.base import Topology
+
+
+def pushsum_round_core(
+    state: PushSumState,
+    nbrs: Optional[CSRNeighbors],
+    base_key: jax.Array,
+    *,
+    n: int,
+    gids,
+    scatter,
+    alive_global: jax.Array,
+    eps: float = 1e-10,
+    streak_target: int = 3,
+    reference_semantics: bool = False,
+) -> PushSumState:
+    """One synchronous round over the rows in ``gids``.
+
+    ``scatter`` is injected (see ``gossip_round_core``); ``alive_global``
+    is the full aliveness mask — push-sum needs the *target's* liveness at
+    the sender (a dead target's half stays with the sender so mass is
+    conserved), and under ``shard_map`` that is an all-gathered copy, taken
+    once per chunk since faults only strike between chunks.
+    """
+    key = jax.random.fold_in(base_key, state.round)
+    targets, valid = sample_neighbors(nbrs, n, key, gids)
+
+    deliver = valid & state.alive & alive_global[targets]
+    s_sent = jnp.where(deliver, state.s * 0.5, jnp.zeros_like(state.s))
+    w_sent = jnp.where(deliver, state.w * 0.5, jnp.zeros_like(state.w))
+
+    in_s, in_w = scatter(s_sent, w_sent, targets)
+
+    s_new = state.s - s_sent + in_s
+    w_new = state.w - w_sent + in_w
+
+    # w stays strictly positive for every alive node (each keeps >= half of
+    # a positive weight); the maximum only guards dead/isolated rows.
+    ratio_new = s_new / jnp.maximum(w_new, jnp.asarray(1e-30, w_new.dtype))
+
+    if reference_semantics:
+        # Program.fs:109-114: delta is computed after the commit and is
+        # identically zero, so the counter advances on every received
+        # message (here: every round with incoming mass).
+        received = in_w > 0
+        streak = jnp.where(received, state.streak + 1, state.streak)
+    else:
+        delta = jnp.abs(ratio_new - state.ratio)
+        streak = jnp.where(delta <= eps, state.streak + 1, 0)
+
+    converged = state.converged | (streak >= streak_target)
+    return PushSumState(
+        s=s_new,
+        w=w_new,
+        ratio=ratio_new,
+        streak=streak,
+        converged=converged,
+        alive=state.alive,
+        round=state.round + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n", "eps", "streak_target", "reference_semantics"),
+    inline=True,
+)
+def pushsum_round(
+    state: PushSumState,
+    nbrs: Optional[CSRNeighbors],
+    base_key: jax.Array,
+    *,
+    n: int,
+    eps: float = 1e-10,
+    streak_target: int = 3,
+    reference_semantics: bool = False,
+) -> PushSumState:
+    """Single-chip round. ``nbrs``/``base_key`` are runtime arguments so one
+    compiled executable serves every same-shape topology and seed."""
+
+    def scatter(s_sent, w_sent, targets):
+        return (
+            jax.ops.segment_sum(s_sent, targets, num_segments=n),
+            jax.ops.segment_sum(w_sent, targets, num_segments=n),
+        )
+
+    return pushsum_round_core(
+        state,
+        nbrs,
+        base_key,
+        n=n,
+        gids=None,
+        scatter=scatter,
+        alive_global=state.alive,
+        eps=eps,
+        streak_target=streak_target,
+        reference_semantics=reference_semantics,
+    )
+
+
+def make_pushsum_round(
+    topo: Topology,
+    base_key: jax.Array,
+    eps: float = 1e-10,
+    streak_target: int = 3,
+    reference_semantics: bool = False,
+):
+    """Closure convenience: bind topology/key, return ``state -> state``."""
+    nbrs = device_topology(topo)
+    n = topo.num_nodes
+
+    def round_fn(state: PushSumState) -> PushSumState:
+        return pushsum_round(
+            state,
+            nbrs,
+            base_key,
+            n=n,
+            eps=eps,
+            streak_target=streak_target,
+            reference_semantics=reference_semantics,
+        )
+
+    return round_fn
+
+
+def pushsum_done(state: PushSumState) -> jax.Array:
+    """Supervisor predicate: every healthy node's estimate has stabilized."""
+    return jnp.all(state.converged | ~state.alive)
+
+
+def mass(state: PushSumState):
+    """(Σs, Σw) — the conservation invariant tests assert on every round."""
+    return state.s.sum(), state.w.sum()

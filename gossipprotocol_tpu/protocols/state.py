@@ -1,0 +1,100 @@
+"""Protocol state containers.
+
+The reference scatters per-node mutable state across actor closures
+(``rumours``, ``sum``/``weight``, ``checkConverge``, ``count`` —
+``Program.fs:66-71``) plus a shared ``Dictionary<IActorRef, bool>``
+(``Program.fs:37``). Here the whole system state is a handful of dense
+arrays in a NamedTuple — a pytree that flows through ``lax.while_loop``,
+shards over a device mesh, and checkpoints as an npz file.
+
+``alive`` supports fault injection (SURVEY.md §5.3): a failed node neither
+sends nor receives, and the convergence predicate ignores it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GossipState(NamedTuple):
+    """Gossip rumor-spreading state (reference: ``rumours`` hit counter +
+    converged flag per actor, ``Program.fs:66,70``)."""
+
+    counts: jax.Array      # int32[N]  times each node has heard the rumor
+    converged: jax.Array   # bool[N]
+    alive: jax.Array       # bool[N]   fault-injection mask (True = healthy)
+    round: jax.Array       # int32 scalar
+
+
+class PushSumState(NamedTuple):
+    """Push-sum averaging state (reference: ``sum``/``weight``/``count``,
+    ``Program.fs:67-69``). ``ratio`` caches s/w from the previous round so
+    the convergence delta is computed *against the pre-update estimate* —
+    the reference's intended predicate, minus its commit-before-compare bug
+    (``Program.fs:109-114``, SURVEY.md §2.4.2)."""
+
+    s: jax.Array           # float[N]  running sum component
+    w: jax.Array           # float[N]  running weight component
+    ratio: jax.Array       # float[N]  previous-round s/w estimate
+    streak: jax.Array      # int32[N]  consecutive rounds with |Δratio| <= eps
+    converged: jax.Array   # bool[N]
+    alive: jax.Array       # bool[N]
+    round: jax.Array       # int32 scalar
+
+
+def gossip_init(num_nodes: int, seed_node: int, dtype=jnp.int32) -> GossipState:
+    """All-zero state with the rumor seeded at ``seed_node``.
+
+    The reference seeds by sending ``Process1`` to a random node
+    (``Program.fs:196``): the seed starts *spreading* with ``rumours = 0``.
+    Bulk-synchronously the spreading condition is ``counts >= 1``, so the
+    seed starts at 1 (its own knowledge of the rumor counts as the first
+    hearing — divergence of at most one hit, documented).
+    """
+    counts = jnp.zeros(num_nodes, dtype).at[seed_node].set(1)
+    return GossipState(
+        counts=counts,
+        converged=jnp.zeros(num_nodes, bool),
+        alive=jnp.ones(num_nodes, bool),
+        round=jnp.int32(0),
+    )
+
+
+def pushsum_init(
+    num_nodes: int,
+    value_mode: str = "scaled",
+    dtype=jnp.float32,
+    reference_semantics: bool = False,
+) -> PushSumState:
+    """Initial push-sum state.
+
+    value_mode:
+      * ``"index"``  — s_i = i, the reference's ``InitialSum x``
+        (``Program.fs:77-78,174``); true average = (N-1)/2. Needs float64
+        beyond ~2^24 nodes for an honest sum.
+      * ``"scaled"`` — s_i = i/N (default): identical convergence dynamics,
+        average → (N-1)/(2N) ≈ 0.5, numerically safe in float32 at 10M+
+        nodes on TPU (documented divergence; the *capability* is s/w →
+        mean of initial values, SURVEY.md §2.4.2).
+
+    ``reference_semantics`` starts the streak counter at 1, mirroring the
+    reference's ``count`` initialized to 1 (``Program.fs:67``), which —
+    combined with its always-zero delta — makes a node "converge" on its
+    2nd received message.
+    """
+    i = jnp.arange(num_nodes, dtype=dtype)
+    s = i / num_nodes if value_mode == "scaled" else i
+    w = jnp.ones(num_nodes, dtype)
+    streak0 = 1 if reference_semantics else 0
+    return PushSumState(
+        s=s,
+        w=w,
+        ratio=s / w,
+        streak=jnp.full(num_nodes, streak0, jnp.int32),
+        converged=jnp.zeros(num_nodes, bool),
+        alive=jnp.ones(num_nodes, bool),
+        round=jnp.int32(0),
+    )

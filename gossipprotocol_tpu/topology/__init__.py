@@ -1,0 +1,30 @@
+from gossipprotocol_tpu.topology.base import Topology, csr_from_edges
+from gossipprotocol_tpu.topology.builders import (
+    build_line,
+    build_full,
+    build_grid3d,
+    build_imp3d,
+    build_erdos_renyi,
+    build_power_law,
+    cube_side,
+)
+from gossipprotocol_tpu.topology.registry import (
+    build_topology,
+    available_topologies,
+    register_topology,
+)
+
+__all__ = [
+    "Topology",
+    "csr_from_edges",
+    "build_line",
+    "build_full",
+    "build_grid3d",
+    "build_imp3d",
+    "build_erdos_renyi",
+    "build_power_law",
+    "cube_side",
+    "build_topology",
+    "available_topologies",
+    "register_topology",
+]

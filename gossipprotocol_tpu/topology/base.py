@@ -1,0 +1,136 @@
+"""Topology representation: CSR neighbor arrays.
+
+The reference delivers each node an ``IActorRef[]`` via a ``NeighbourRef``
+message (``Program.fs:191,216,261``). Here a topology is a pure value: a
+compressed-sparse-row adjacency over node indices, which a protocol round
+consumes with a single gather (``indices[offsets[i] + slot]``). CSR (rather
+than a padded ``[N, max_deg]`` matrix) keeps power-law hub degrees from
+blowing up memory and keeps the random-neighbor draw a single vectorized
+gather on TPU.
+
+The *full* topology is never materialized — the reference builds O(n²) ref
+arrays and hits a memory wall around 9k nodes (``Program.fs:211-216``,
+README.md:4); we sample a uniform non-self node implicitly, which scales to
+10M+ nodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """A static undirected neighbor structure over ``num_nodes`` nodes.
+
+    Attributes:
+      kind: builder name ("line", "full", "3D", "imp3D", "erdos_renyi",
+        "power_law", ...).
+      num_nodes: number of nodes actually in the graph. May differ from the
+        requested count: the 3D builders round up to the next perfect cube,
+        mirroring the reference's ``ceil(cbrt n)**3`` (``Program.fs:239-240``).
+      offsets: int32[num_nodes + 1] CSR row offsets, or None for implicit
+        topologies.
+      indices: int32[num_edges * 2] CSR column indices (each undirected edge
+        appears once per endpoint), or None for implicit topologies.
+      implicit_full: if True the graph is the complete graph K_n and
+        neighbors are sampled implicitly (uniform over [0, n) \\ {i}).
+    """
+
+    kind: str
+    num_nodes: int
+    offsets: Optional[np.ndarray]
+    indices: Optional[np.ndarray]
+    implicit_full: bool = False
+
+    def __post_init__(self):
+        if self.implicit_full:
+            if self.offsets is not None or self.indices is not None:
+                raise ValueError("implicit_full topology must not carry CSR arrays")
+            return
+        if self.offsets is None or self.indices is None:
+            raise ValueError("explicit topology requires offsets and indices")
+        if self.offsets.shape != (self.num_nodes + 1,):
+            raise ValueError(
+                f"offsets shape {self.offsets.shape} != ({self.num_nodes + 1},)"
+            )
+        if self.offsets[0] != 0 or self.offsets[-1] != len(self.indices):
+            raise ValueError("offsets must span indices exactly")
+
+    # -- derived views ----------------------------------------------------
+
+    @property
+    def degree(self) -> np.ndarray:
+        """int32[num_nodes] per-node neighbor count."""
+        if self.implicit_full:
+            return np.full(self.num_nodes, self.num_nodes - 1, dtype=np.int32)
+        return np.diff(self.offsets).astype(np.int32)
+
+    @property
+    def num_directed_edges(self) -> int:
+        if self.implicit_full:
+            return self.num_nodes * (self.num_nodes - 1)
+        return int(len(self.indices))
+
+    @property
+    def max_degree(self) -> int:
+        if self.implicit_full:
+            return self.num_nodes - 1
+        deg = self.degree
+        return int(deg.max()) if len(deg) else 0
+
+    def neighbors_of(self, i: int) -> np.ndarray:
+        """Neighbor indices of node ``i`` (host-side helper for tests/tools)."""
+        if self.implicit_full:
+            return np.setdiff1d(np.arange(self.num_nodes, dtype=np.int32), [i])
+        return self.indices[self.offsets[i] : self.offsets[i + 1]]
+
+    def validate(self) -> None:
+        """Structural sanity checks (used by tests and the CLI --check flag)."""
+        if self.implicit_full:
+            assert self.num_nodes >= 2, "full topology needs >= 2 nodes"
+            return
+        n = self.num_nodes
+        assert (np.diff(self.offsets) >= 0).all(), "offsets must be monotone"
+        if len(self.indices):
+            assert self.indices.min() >= 0 and self.indices.max() < n, (
+                "neighbor index out of range"
+            )
+        # no self-loops
+        row = np.repeat(np.arange(n, dtype=np.int64), np.diff(self.offsets))
+        assert not (row == self.indices).any(), "self-loop present"
+
+
+def csr_from_edges(num_nodes: int, edges: np.ndarray, kind: str) -> Topology:
+    """Build a symmetric CSR Topology from an undirected edge list [E, 2].
+
+    Deduplicates repeated edges and drops self-loops so every builder yields
+    a simple graph.
+    """
+    edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    # drop self-loops
+    edges = edges[edges[:, 0] != edges[:, 1]]
+    # canonicalize (lo, hi) and dedup
+    lo = np.minimum(edges[:, 0], edges[:, 1])
+    hi = np.maximum(edges[:, 0], edges[:, 1])
+    key = lo * num_nodes + hi
+    _, uniq = np.unique(key, return_index=True)
+    lo, hi = lo[uniq], hi[uniq]
+    # symmetrize: each undirected edge contributes both directions
+    src = np.concatenate([lo, hi])
+    dst = np.concatenate([hi, lo])
+    order = np.argsort(src, kind="stable")
+    src, dst = src[order], dst[order]
+    counts = np.bincount(src, minlength=num_nodes)
+    offsets = np.zeros(num_nodes + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    itype = np.int32 if len(dst) < 2**31 else np.int64
+    return Topology(
+        kind=kind,
+        num_nodes=num_nodes,
+        offsets=offsets.astype(itype),
+        indices=dst.astype(np.int32),
+    )

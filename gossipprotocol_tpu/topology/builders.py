@@ -1,0 +1,164 @@
+"""Topology builders.
+
+Pure functions replacing the reference's actor-wiring match block
+(``Program.fs:178-279``): each returns a :class:`Topology` (CSR neighbor
+arrays) instead of delivering ``NeighbourRef`` messages to live actors.
+
+The four reference topologies keep the reference's *shape rules*:
+
+* ``line``  — path graph; endpoints have one neighbor (``Program.fs:184-189``).
+* ``full``  — complete graph; represented implicitly, never materialized
+  (the reference materializes O(n²) ref arrays, ``Program.fs:211-216``).
+* ``3D``    — node count rounded **up** to the next perfect cube
+  ``ceil(cbrt n)**3`` and wired as a 6-connected lattice via
+  ``i*g² + j*g + k`` index arithmetic (``Program.fs:239-257``).
+* ``imp3D`` — 3D plus one uniform-random extra neighbor per node
+  (``Program.fs:258-260``). Divergence from the reference, documented: the
+  extra neighbor here is always a proper non-self node drawn over the whole
+  index range (the reference's ``Random().Next(0, nodes-1)`` excludes the two
+  highest indices and may pick self or duplicate a lattice neighbor — an
+  off-by-one quirk, not a capability).
+
+Two additional random families, per the BASELINE.json north-star configs
+("10M-node push-sum on Erdős–Rényi / power-law graphs"):
+
+* ``erdos_renyi`` — G(n, M) with M = avg_degree·n/2 sampled edges.
+* ``power_law``   — preferential-attachment (Barabási–Albert) graph, built
+  with the vectorized repeated-endpoint trick so 10M-node graphs build in
+  seconds on the host.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from gossipprotocol_tpu.topology.base import Topology, csr_from_edges
+
+
+def build_line(num_nodes: int) -> Topology:
+    """Path graph 0—1—…—(n−1)."""
+    if num_nodes < 2:
+        raise ValueError("line topology needs >= 2 nodes")
+    a = np.arange(num_nodes - 1, dtype=np.int64)
+    edges = np.stack([a, a + 1], axis=1)
+    return csr_from_edges(num_nodes, edges, kind="line")
+
+
+def build_full(num_nodes: int) -> Topology:
+    """Complete graph K_n, implicit (sampled, never materialized)."""
+    if num_nodes < 2:
+        raise ValueError("full topology needs >= 2 nodes")
+    return Topology(
+        kind="full", num_nodes=num_nodes, offsets=None, indices=None,
+        implicit_full=True,
+    )
+
+
+def cube_side(num_nodes: int) -> int:
+    """Smallest g with g**3 >= num_nodes (reference's ``ceil(cbrt n)``,
+    ``Program.fs:239``)."""
+    g = int(round(num_nodes ** (1.0 / 3.0)))
+    while g**3 < num_nodes:
+        g += 1
+    while g > 1 and (g - 1) ** 3 >= num_nodes:
+        g -= 1
+    return g
+
+
+def _grid3d_edges(g: int) -> np.ndarray:
+    """Directed-once edge list of the 6-connected g×g×g lattice."""
+    idx = np.arange(g**3, dtype=np.int64).reshape(g, g, g)
+    edges = []
+    # +1 step along each axis covers every lattice edge exactly once
+    edges.append(np.stack([idx[:-1, :, :].ravel(), idx[1:, :, :].ravel()], axis=1))
+    edges.append(np.stack([idx[:, :-1, :].ravel(), idx[:, 1:, :].ravel()], axis=1))
+    edges.append(np.stack([idx[:, :, :-1].ravel(), idx[:, :, 1:].ravel()], axis=1))
+    return np.concatenate(edges, axis=0)
+
+
+def build_grid3d(num_nodes: int) -> Topology:
+    """6-connected 3-D lattice on ``ceil(cbrt n)**3`` nodes (rounded up,
+    mirroring ``Program.fs:239-240``)."""
+    g = cube_side(num_nodes)
+    n = g**3
+    topo = csr_from_edges(n, _grid3d_edges(g), kind="3D")
+    return topo
+
+
+def build_imp3d(num_nodes: int, seed: int = 0) -> Topology:
+    """3-D lattice + one uniform-random extra neighbor per node
+    (``Program.fs:258-260``; see module docstring for the documented
+    divergence from the reference's off-by-one range)."""
+    g = cube_side(num_nodes)
+    n = g**3
+    rng = np.random.default_rng(seed)
+    extra_dst = rng.integers(0, n - 1, size=n, dtype=np.int64)
+    src = np.arange(n, dtype=np.int64)
+    extra_dst = extra_dst + (extra_dst >= src)  # uniform over [0, n) \ {i}
+    extra = np.stack([src, extra_dst], axis=1)
+    edges = np.concatenate([_grid3d_edges(g), extra], axis=0)
+    topo = csr_from_edges(n, edges, kind="imp3D")
+    return topo
+
+
+def build_erdos_renyi(num_nodes: int, avg_degree: float = 8.0, seed: int = 0) -> Topology:
+    """G(n, M) random graph with M ≈ avg_degree·n/2 undirected edges.
+
+    Uses the G(n, M) model (sample M random pairs) rather than per-pair coin
+    flips so 10M-node graphs are O(M) to build. Duplicate pairs and
+    self-loops are dropped by ``csr_from_edges``, so realized mean degree is
+    marginally below ``avg_degree`` for dense settings.
+    """
+    if num_nodes < 2:
+        raise ValueError("erdos_renyi needs >= 2 nodes")
+    rng = np.random.default_rng(seed)
+    m = int(round(avg_degree * num_nodes / 2.0))
+    m = min(m, num_nodes * (num_nodes - 1) // 2)
+    src = rng.integers(0, num_nodes, size=m, dtype=np.int64)
+    dst = rng.integers(0, num_nodes, size=m, dtype=np.int64)
+    edges = np.stack([src, dst], axis=1)
+    return csr_from_edges(num_nodes, edges, kind="erdos_renyi")
+
+
+def build_power_law(num_nodes: int, m: int = 4, seed: int = 0) -> Topology:
+    """Barabási–Albert preferential-attachment graph (power-law degrees).
+
+    Vectorized chunked construction: a new node's ``m`` targets are drawn
+    uniformly from the *endpoint list* of edges created so far (the classic
+    repeated-nodes trick — endpoint frequency ∝ degree), with chunks of new
+    nodes attaching against the endpoint list frozen at the chunk start.
+    This is a standard O(E) approximation of sequential BA that preserves
+    the power-law tail while building 10M-node graphs in seconds.
+    """
+    if num_nodes < m + 1:
+        raise ValueError("power_law needs num_nodes > m")
+    rng = np.random.default_rng(seed)
+    # seed clique on m+1 nodes
+    seed_nodes = np.arange(m + 1, dtype=np.int64)
+    si, sj = np.triu_indices(m + 1, k=1)
+    edge_src = [seed_nodes[si]]
+    edge_dst = [seed_nodes[sj]]
+    endpoints = np.concatenate([seed_nodes[si], seed_nodes[sj]])
+
+    start = m + 1
+    chunk = max(1024, (num_nodes - start) // 64 or 1)
+    while start < num_nodes:
+        stop = min(start + chunk, num_nodes)
+        new = np.arange(start, stop, dtype=np.int64)
+        # each new node draws m endpoints (∝ degree at chunk start)
+        draws = endpoints[rng.integers(0, len(endpoints), size=(len(new), m))]
+        src = np.repeat(new, m)
+        dst = draws.ravel()
+        edge_src.append(src)
+        edge_dst.append(dst)
+        endpoints = np.concatenate([endpoints, src, dst])
+        start = stop
+
+    edges = np.stack([np.concatenate(edge_src), np.concatenate(edge_dst)], axis=1)
+    topo = csr_from_edges(num_nodes, edges, kind="power_law")
+    # BA can leave duplicate draws collapsed; isolated nodes are impossible
+    # (every new node keeps >= 1 distinct target since draws include at
+    # least one endpoint != itself).
+    return topo

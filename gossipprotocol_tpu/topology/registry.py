@@ -1,0 +1,68 @@
+"""Pluggable topology registry.
+
+The reference dispatches on a topology string in one match block
+(``Program.fs:178-279``) with unknown names silently doing nothing
+(``Program.fs:279``). Here the dispatch is an explicit registry: unknown
+names raise with the list of valid options, and new families (per the
+BASELINE.json north star: Erdős–Rényi, power-law) register without touching
+the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from gossipprotocol_tpu.topology.base import Topology
+from gossipprotocol_tpu.topology import builders
+
+_REGISTRY: Dict[str, Callable[..., Topology]] = {}
+
+# Reference names (Program.fs match arms) plus casual aliases.
+_ALIASES = {
+    "line": "line",
+    "full": "full",
+    "3d": "3D",
+    "imp3d": "imp3D",
+    "imperfect3d": "imp3D",
+    "er": "erdos_renyi",
+    "erdos_renyi": "erdos_renyi",
+    "erdos-renyi": "erdos_renyi",
+    "powerlaw": "power_law",
+    "power_law": "power_law",
+    "power-law": "power_law",
+}
+
+
+def register_topology(name: str, fn: Callable[..., Topology]) -> None:
+    _REGISTRY[name] = fn
+
+
+register_topology("line", builders.build_line)
+register_topology("full", builders.build_full)
+register_topology("3D", builders.build_grid3d)
+register_topology("imp3D", builders.build_imp3d)
+register_topology("erdos_renyi", builders.build_erdos_renyi)
+register_topology("power_law", builders.build_power_law)
+
+
+def available_topologies() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def build_topology(name: str, num_nodes: int, **kwargs) -> Topology:
+    """Build topology ``name`` over ``num_nodes`` nodes.
+
+    Builder-specific kwargs (``seed``, ``avg_degree``, ``m``) pass through;
+    builders that don't take them have them filtered out.
+    """
+    canonical = _ALIASES.get(name.lower(), name)
+    if canonical not in _REGISTRY:
+        raise ValueError(
+            f"unknown topology {name!r}; available: {available_topologies()}"
+        )
+    fn = _REGISTRY[canonical]
+    import inspect
+
+    params = inspect.signature(fn).parameters
+    kwargs = {k: v for k, v in kwargs.items() if k in params}
+    return fn(num_nodes, **kwargs)

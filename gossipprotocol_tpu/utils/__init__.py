@@ -1,0 +1,3 @@
+from gossipprotocol_tpu.utils import checkpoint, faults, metrics, profiling
+
+__all__ = ["checkpoint", "faults", "metrics", "profiling"]

@@ -1,0 +1,66 @@
+"""Checkpoint / resume (SURVEY.md §5.4).
+
+The reference keeps all state in actor memory and discards it with
+``Environment.Exit(0)`` (``Program.fs:56``). Here the entire system state is
+a small pytree of dense arrays, so a checkpoint is one compressed npz file:
+state arrays + enough config metadata to validate a resume. Orbax is
+unnecessary at this state size (a 10M-node push-sum state is ~200 MB); npz
+keeps checkpoints dependency-free and host-portable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Tuple
+
+import jax
+import numpy as np
+
+from gossipprotocol_tpu.protocols.state import GossipState, PushSumState
+
+_STATE_TYPES = {"GossipState": GossipState, "PushSumState": PushSumState}
+
+
+def save(directory: str, state, cfg, topo_kind: str) -> str:
+    """Write ``state`` to ``directory/ckpt_round{R}.npz``; returns the path."""
+    os.makedirs(directory, exist_ok=True)
+    host = jax.device_get(state)
+    arrays = {f: np.asarray(v) for f, v in zip(type(state)._fields, host)}
+    meta = {
+        "state_type": type(state).__name__,
+        "round": int(arrays["round"]),
+        "algorithm": getattr(cfg, "algorithm", None),
+        "seed": getattr(cfg, "seed", None),
+        "semantics": getattr(cfg, "semantics", None),
+        "topology": topo_kind,
+        "saved_at": time.time(),
+    }
+    path = os.path.join(directory, f"ckpt_round{meta['round']:09d}.npz")
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, __meta__=json.dumps(meta), **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def load(path: str) -> Tuple[object, dict]:
+    """Load a checkpoint; returns (state pytree, metadata dict)."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        cls = _STATE_TYPES[meta["state_type"]]
+        import jax.numpy as jnp
+
+        fields = [jnp.asarray(z[f]) for f in cls._fields]
+    return cls(*fields), meta
+
+
+def latest(directory: str) -> str | None:
+    """Path of the newest checkpoint in ``directory``, or None."""
+    if not os.path.isdir(directory):
+        return None
+    cands = sorted(
+        f for f in os.listdir(directory)
+        if f.startswith("ckpt_round") and f.endswith(".npz")
+    )
+    return os.path.join(directory, cands[-1]) if cands else None

@@ -1,0 +1,39 @@
+"""Fault injection (SURVEY.md §5.3).
+
+The reference has no failure handling — an actor crash would hang the
+supervisor forever. Here failures are a first-class *simulated* capability
+(gossip's robustness under node loss is the algorithm's whole point): a
+fault plan maps a round number to the node ids that die at that round. The
+driver applies the plan between chunks; dead nodes neither send nor
+receive, and the supervisor's predicate ignores them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+
+def random_fault_plan(
+    num_nodes: int,
+    fraction: float,
+    at_round: int,
+    seed: int = 0,
+) -> Dict[int, np.ndarray]:
+    """Kill a uniform-random ``fraction`` of nodes at ``at_round``."""
+    if not 0.0 <= fraction < 1.0:
+        raise ValueError("fraction must be in [0, 1)")
+    rng = np.random.default_rng(seed)
+    k = int(round(num_nodes * fraction))
+    ids = rng.choice(num_nodes, size=k, replace=False)
+    return {int(at_round): np.sort(ids)}
+
+
+def merge_plans(*plans: Dict[int, Sequence[int]]) -> Dict[int, np.ndarray]:
+    out: Dict[int, np.ndarray] = {}
+    for plan in plans:
+        for r, ids in plan.items():
+            prev = out.get(int(r), np.empty(0, dtype=np.int64))
+            out[int(r)] = np.unique(np.concatenate([prev, np.asarray(ids, np.int64)]))
+    return out

@@ -1,0 +1,48 @@
+"""Structured metrics / observability (SURVEY.md §5.5).
+
+The reference's entire observability surface is three ``printfn`` lines —
+two start banners and the one metric (``Program.fs:55,198,204``). Here
+every chunk of rounds emits a structured record (round, #converged, ratio
+spread), streamable to a JSONL file for the BASELINE-style curves, and the
+final metric is printed in the reference's exact format so downstream
+tooling that scraped the F# output keeps working.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import IO, Optional
+
+
+class JsonlMetricsWriter:
+    """Append one JSON object per metrics record to a file (or stream)."""
+
+    def __init__(self, path_or_stream):
+        if isinstance(path_or_stream, str):
+            self._fh: IO = open(path_or_stream, "a", buffering=1)
+            self._owns = True
+        else:
+            self._fh = path_or_stream
+            self._owns = False
+
+    def __call__(self, record: dict) -> None:
+        self._fh.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        if self._owns:
+            self._fh.close()
+
+
+def print_start_banner(algorithm: str, stream: Optional[IO] = None) -> None:
+    """Reference start banners: "Gossip Starts" / "Push Sum Starts"
+    (``Program.fs:198,204``)."""
+    stream = stream or sys.stdout
+    print("Gossip Starts" if algorithm == "gossip" else "Push Sum Starts", file=stream)
+
+
+def print_convergence_time(wall_ms: float, stream: Optional[IO] = None) -> None:
+    """The reference's single output metric, format-compatible with
+    ``printfn "Convergence Time: %f ms"`` (``Program.fs:55``)."""
+    stream = stream or sys.stdout
+    print(f"Convergence Time: {wall_ms:f} ms", file=stream)

@@ -1,0 +1,46 @@
+"""Test harness: run everything on CPU with 8 simulated XLA devices.
+
+The TPU analogue of a fake multi-node backend (SURVEY.md §4.4): sharding /
+psum paths exercise a real 8-device mesh without hardware.
+
+Note: this image's sitecustomize pre-imports jax and pins the remote-TPU
+("axon") platform before conftest runs, so flipping ``JAX_PLATFORMS`` here
+is too late. Instead we (a) set ``XLA_FLAGS`` before the *CPU* client's
+lazy init so ``jax.devices("cpu")`` yields 8 devices, and (b) point
+``jax_default_device`` at CPU so every test computation runs there — fast
+local compiles, no tunnel round-trips.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # effective when run standalone
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# repo root on sys.path so `import gossipprotocol_tpu` works uninstalled
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# persistent XLA compile cache: this box has one CPU core and pays seconds
+# per fresh compile; cached reruns of the suite are near-instant
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+_CPU_DEVICES = jax.devices("cpu")  # initializes CPU client under XLA_FLAGS
+assert len(_CPU_DEVICES) >= 8, (
+    f"expected 8 simulated CPU devices, got {len(_CPU_DEVICES)}"
+)
+jax.config.update("jax_default_device", _CPU_DEVICES[0])
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    return _CPU_DEVICES

@@ -1,0 +1,78 @@
+"""CLI smoke tests (SURVEY.md §4.3): reference-compatible surface and
+output format for topology × algorithm combos."""
+
+import io
+import re
+import sys
+
+import pytest
+
+from gossipprotocol_tpu.cli import main
+
+
+def run_cli(args, capsys):
+    code = main(args)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+@pytest.mark.parametrize("topology", ["line", "full", "3D", "imp3D"])
+def test_reference_combos_gossip(topology, capsys):
+    code, out, _ = run_cli([
+        "27", topology, "gossip", "--seed", "0", "--chunk-rounds", "64",
+    ], capsys)
+    assert code == 0
+    assert "Gossip Starts" in out
+    # reference output format: printfn "Convergence Time: %f ms" (Program.fs:55)
+    assert re.search(r"Convergence Time: \d+\.\d+ ms", out)
+
+
+def test_pushsum_cli_banner_and_metric(capsys):
+    code, out, _ = run_cli(["64", "full", "push-sum", "--seed", "1"], capsys)
+    assert code == 0
+    assert "Push Sum Starts" in out
+    assert re.search(r"Convergence Time: \d+\.\d+ ms", out)
+
+
+def test_pushsum_alias_accepted(capsys):
+    code, out, _ = run_cli(["32", "full", "pushsum", "--quiet"], capsys)
+    assert code == 0
+
+
+def test_invalid_algorithm_matches_reference_message(capsys):
+    # reference prints "option invalid" (Program.fs:207); we do too, loudly
+    code, _, err = run_cli(["10", "full", "wiretap"], capsys)
+    assert code == 2
+    assert "option invalid" in err
+
+
+def test_invalid_topology_errors_loudly(capsys):
+    # reference silently no-ops (Program.fs:279) — documented improvement
+    code, _, err = run_cli(["10", "torus", "gossip"], capsys)
+    assert code == 2
+    assert "unknown topology" in err
+
+
+def test_cube_rounding_note(capsys):
+    code, out, _ = run_cli(["28", "3D", "gossip", "--seed", "0"], capsys)
+    assert code == 0
+    assert "rounds 28 up to 64" in out
+
+
+def test_metrics_out_jsonl(tmp_path, capsys):
+    import json
+
+    path = str(tmp_path / "metrics.jsonl")
+    code, _, _ = run_cli(
+        ["32", "full", "gossip", "--metrics-out", path, "--quiet"], capsys
+    )
+    assert code == 0
+    records = [json.loads(line) for line in open(path)]
+    assert records and all("converged" in r for r in records)
+
+
+def test_fault_injection_flag(capsys):
+    code, out, _ = run_cli(
+        ["64", "full", "gossip", "--fail-fraction", "0.1", "--seed", "3"], capsys
+    )
+    assert code == 0

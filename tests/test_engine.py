@@ -1,0 +1,178 @@
+"""Driver / supervisor tests (SURVEY.md §4.3): deterministic-seed golden
+round counts, metric plumbing, checkpoint/resume, fault plans."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.engine import resume_simulation
+from gossipprotocol_tpu.utils import checkpoint as ckpt
+from gossipprotocol_tpu.utils import faults
+
+
+def test_gossip_end_to_end_line():
+    """The minimum end-to-end slice (SURVEY.md §7 step 2): line-topology
+    gossip converges and reports a positive convergence time."""
+    topo = build_topology("line", 32)
+    res = run_simulation(topo, RunConfig(algorithm="gossip", seed=1, chunk_rounds=64))
+    assert res.converged
+    assert res.rounds > 0
+    assert res.wall_ms > 0
+    assert res.num_nodes == 32
+    assert res.metrics[-1]["converged"] == 32
+
+
+def test_pushsum_end_to_end_full():
+    topo = build_topology("full", 64)
+    res = run_simulation(topo, RunConfig(algorithm="push-sum", seed=1, chunk_rounds=128))
+    assert res.converged
+    assert res.estimate_error is not None and res.estimate_error < 1e-3
+
+
+def test_deterministic_round_count():
+    """Same seed ⇒ identical rounds-to-convergence (golden replay)."""
+    topo = build_topology("imp3D", 27, seed=4)
+    r1 = run_simulation(topo, RunConfig(algorithm="gossip", seed=11))
+    r2 = run_simulation(topo, RunConfig(algorithm="gossip", seed=11))
+    assert r1.rounds == r2.rounds
+    assert np.array_equal(np.asarray(r1.final_state.counts),
+                          np.asarray(r2.final_state.counts))
+
+
+def test_max_rounds_bound_exact():
+    """keep_alive off can strand nodes (the liveness hole Actor2 papers
+    over, Program.fs:141-163); max_rounds bounds the run *exactly* even
+    when it falls mid-chunk."""
+    topo = build_topology("line", 64)
+    cfg = RunConfig(algorithm="gossip", keep_alive=False, max_rounds=50,
+                    chunk_rounds=512, seed=0)
+    res = run_simulation(topo, cfg)
+    assert res.rounds == 50
+    assert not res.converged
+
+
+def test_fault_strikes_exactly_at_scheduled_round():
+    """A fault scheduled mid-chunk must split the chunk — the device loop
+    stops at the fault round, the host applies it, the run continues."""
+    topo = build_topology("full", 64)
+    plan = {5: np.arange(10)}
+    cfg = RunConfig(algorithm="gossip", seed=0, fault_plan=plan,
+                    chunk_rounds=512)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    # two chunk records: one ending at round 5 (the fault boundary), then
+    # the rest of the run with 10 fewer healthy nodes
+    assert res.metrics[0]["round"] == 5
+    assert res.metrics[0]["alive"] == 64
+    assert res.metrics[-1]["alive"] == 54
+
+
+def test_isolated_nodes_excluded_from_predicate():
+    """Degree-0 nodes (expected in sparse Erdős–Rényi graphs) can never
+    hear the rumor; they are excluded up front like dead nodes instead of
+    making the run grind to max_rounds."""
+    from gossipprotocol_tpu.topology import csr_from_edges
+
+    # nodes 0..3 form a path, node 4 is isolated
+    topo = csr_from_edges(5, np.array([[0, 1], [1, 2], [2, 3]]), kind="er-ish")
+    cfg = RunConfig(algorithm="gossip", seed=0, seed_node=0, chunk_rounds=64)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    counts = np.asarray(res.final_state.counts)
+    assert (counts[:4] >= 10).all()
+    assert counts[4] == 0
+    assert not bool(np.asarray(res.final_state.alive)[4])
+
+
+def test_metrics_callback_stream():
+    topo = build_topology("full", 32)
+    records = []
+    cfg = RunConfig(algorithm="gossip", chunk_rounds=8,
+                    metrics_callback=records.append)
+    res = run_simulation(topo, cfg)
+    assert len(records) == len(res.metrics)
+    assert all("round" in r and "converged" in r for r in records)
+    rounds = [r["round"] for r in records]
+    assert rounds == sorted(rounds)
+
+
+def test_checkpoint_save_load_resume(tmp_path):
+    topo = build_topology("full", 64)
+    cfg = RunConfig(algorithm="push-sum", seed=3, chunk_rounds=4,
+                    checkpoint_every=1, checkpoint_dir=str(tmp_path),
+                    max_rounds=8)
+    res = run_simulation(topo, cfg)
+    assert res.checkpoints, "no checkpoint written"
+    latest = ckpt.latest(str(tmp_path))
+    assert latest is not None and os.path.exists(latest)
+
+    state, meta = ckpt.load(latest)
+    assert meta["algorithm"] == "push-sum"
+    assert int(state.round) > 0
+
+    cfg2 = RunConfig(algorithm="push-sum", seed=3, chunk_rounds=128)
+    res2 = resume_simulation(topo, cfg2, state)
+    assert res2.converged
+    assert res2.rounds > int(state.round)
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    """Checkpoint/resume is semantically transparent: same final counts as
+    an uninterrupted run with the same seed (counter-based PRNG keyed on
+    the absolute round makes this exact)."""
+    topo = build_topology("imp3D", 27, seed=5)
+    cfg = RunConfig(algorithm="gossip", seed=9, chunk_rounds=16)
+    full = run_simulation(topo, cfg)
+
+    cfg_a = RunConfig(algorithm="gossip", seed=9, chunk_rounds=16, max_rounds=16,
+                      checkpoint_every=1, checkpoint_dir=str(tmp_path))
+    run_simulation(topo, cfg_a)
+    state, _ = ckpt.load(ckpt.latest(str(tmp_path)))
+    resumed = resume_simulation(topo, cfg, state)
+
+    assert resumed.rounds == full.rounds
+    assert np.array_equal(np.asarray(resumed.final_state.counts),
+                          np.asarray(full.final_state.counts))
+
+
+def test_fault_plan_gossip_survives():
+    """Gossip robustness under node loss — the capability fault injection
+    exists to demonstrate (SURVEY.md §5.3)."""
+    topo = build_topology("full", 128)
+    plan = faults.random_fault_plan(128, fraction=0.2, at_round=0, seed=2)
+    dead = next(iter(plan.values()))
+    seed_node = next(i for i in range(128) if i not in set(dead.tolist()))
+    cfg = RunConfig(algorithm="gossip", seed=2, seed_node=seed_node,
+                    fault_plan=plan, chunk_rounds=64)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    assert res.metrics[-1]["alive"] == 128 - len(dead)
+
+
+def test_stall_detection_dead_seed():
+    topo = build_topology("full", 32)
+    cfg = RunConfig(algorithm="gossip", seed=0, seed_node=5,
+                    fault_plan={0: np.array([5])}, chunk_rounds=64)
+    res = run_simulation(topo, cfg)
+    assert not res.converged
+    assert res.rounds <= 64
+    assert res.metrics[-1].get("stalled") is True
+
+
+def test_invalid_algorithm_raises():
+    with pytest.raises(ValueError, match="option invalid|unknown algorithm"):
+        RunConfig(algorithm="chatter")
+
+
+def test_estimate_error_ignores_stranded_dead_mass():
+    """estimate_error must compare healthy nodes against the *achievable*
+    mean (dead nodes' mass is stranded)."""
+    topo = build_topology("full", 32)
+    plan = {0: np.array([0, 1, 2, 3])}
+    cfg = RunConfig(algorithm="push-sum", seed=1, fault_plan=plan,
+                    chunk_rounds=128)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    assert res.estimate_error < 1e-3

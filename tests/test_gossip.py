@@ -1,0 +1,122 @@
+"""Gossip protocol invariants (SURVEY.md §4.2): hit counts monotone,
+converged ⇒ count >= threshold, all nodes converge on connected graphs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossipprotocol_tpu import build_topology
+from gossipprotocol_tpu.protocols import (
+    gossip_init,
+    make_gossip_round,
+    gossip_done,
+)
+
+
+def run_rounds(topo, rounds, threshold=10, keep_alive=True, seed=0, state=None):
+    key = jax.random.key(seed)
+    step = jax.jit(make_gossip_round(topo, key, threshold, keep_alive))
+    state = state or gossip_init(topo.num_nodes, seed_node=0)
+    history = [state]
+    for _ in range(rounds):
+        state = step(state)
+        history.append(state)
+    return history
+
+
+def test_counts_monotone_and_converged_implies_threshold():
+    topo = build_topology("line", 32)
+    hist = run_rounds(topo, 200)
+    for a, b in zip(hist, hist[1:]):
+        assert (np.asarray(b.counts) >= np.asarray(a.counts)).all()
+        # converged is sticky
+        assert (np.asarray(b.converged) >= np.asarray(a.converged)).all()
+    final = hist[-1]
+    conv = np.asarray(final.converged)
+    assert (np.asarray(final.counts)[conv] >= 10).all()
+
+
+def _converge(topo, max_rounds=5000, **kw):
+    key = jax.random.key(kw.pop("seed", 0))
+    step = jax.jit(make_gossip_round(topo, key, kw.pop("threshold", 10),
+                                     kw.pop("keep_alive", True)))
+    state = gossip_init(topo.num_nodes, seed_node=0)
+    for _ in range(max_rounds):
+        state = step(state)
+        if bool(gossip_done(state)):
+            return state
+    raise AssertionError(f"no convergence in {max_rounds} rounds")
+
+
+def test_all_topologies_converge():
+    for name, n in [("line", 24), ("full", 64), ("3D", 27), ("imp3D", 27),
+                    ("erdos_renyi", 64), ("power_law", 64)]:
+        topo = build_topology(name, n, seed=1)
+        state = _converge(topo)
+        assert bool(jnp.all(state.converged))
+
+
+def test_no_delivery_to_converged_nodes():
+    """Converged nodes' counts freeze (sender-side dict check,
+    Program.fs:87-88)."""
+    topo = build_topology("full", 32)
+    key = jax.random.key(0)
+    step = jax.jit(make_gossip_round(topo, key, threshold=10))
+    state = gossip_init(32, seed_node=0)
+    prev_counts = None
+    for _ in range(400):
+        conv_before = np.asarray(state.converged)
+        counts_before = np.asarray(state.counts)
+        state = step(state)
+        counts_after = np.asarray(state.counts)
+        assert (counts_after[conv_before] == counts_before[conv_before]).all()
+        if bool(gossip_done(state)):
+            break
+    assert bool(gossip_done(state))
+
+
+def test_keep_alive_guarantees_line_liveness():
+    """With keep_alive (the Actor2 analogue, Program.fs:141-163) a long line
+    always converges; threshold is reached at every node."""
+    topo = build_topology("line", 64)
+    state = _converge(topo, max_rounds=20000)
+    assert (np.asarray(state.counts) >= 10).all()
+
+
+def test_reference_threshold_is_eleven():
+    """--semantics reference: converge on the 11th hearing
+    (Program.fs:91-92)."""
+    topo = build_topology("full", 16)
+    state = _converge(topo, threshold=11)
+    assert (np.asarray(state.counts)[np.asarray(state.converged)] >= 11).all()
+
+
+def test_deterministic_replay():
+    """Same seed ⇒ bitwise-identical trajectory (counter-based PRNG; the
+    reference's time-seeded Random() could never do this)."""
+    topo = build_topology("imp3D", 27, seed=2)
+    h1 = run_rounds(topo, 50, seed=7)
+    h2 = run_rounds(topo, 50, seed=7)
+    assert (np.asarray(h1[-1].counts) == np.asarray(h2[-1].counts)).all()
+    h3 = run_rounds(topo, 50, seed=8)
+    assert (np.asarray(h1[-1].counts) != np.asarray(h3[-1].counts)).any()
+
+
+def test_fault_injection_excluded_from_predicate():
+    topo = build_topology("full", 32)
+    key = jax.random.key(0)
+    step = jax.jit(make_gossip_round(topo, key, threshold=10))
+    state = gossip_init(32, seed_node=0)
+    # kill 4 nodes up front
+    dead = np.array([3, 9, 17, 30])
+    state = state._replace(alive=state.alive.at[dead].set(False))
+    for _ in range(500):
+        state = step(state)
+        if bool(gossip_done(state)):
+            break
+    assert bool(gossip_done(state))
+    counts = np.asarray(state.counts)
+    # dead nodes received nothing after death (they started at 0 hits)
+    assert (counts[dead] == 0).all()
+    alive = np.asarray(state.alive)
+    assert (counts[alive] >= 10).all()

@@ -1,0 +1,112 @@
+"""Push-sum invariants (SURVEY.md §4.2): mass conservation per round and
+s/w → mean(initial) — the properties the reference could never test because
+its convergence predicate was broken (Program.fs:109-114)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossipprotocol_tpu import build_topology
+from gossipprotocol_tpu.protocols import (
+    pushsum_init,
+    make_pushsum_round,
+    pushsum_done,
+    mass,
+)
+
+
+def make(topo, seed=0, **kw):
+    key = jax.random.key(seed)
+    init_kw = {k: kw.pop(k) for k in ("value_mode", "dtype", "reference_semantics")
+               if k in kw and k != "reference_semantics"}
+    ref = kw.get("reference_semantics", False)
+    state = pushsum_init(topo.num_nodes, reference_semantics=ref, **init_kw)
+    step = jax.jit(make_pushsum_round(topo, key, **kw))
+    return state, step
+
+
+def test_mass_conservation_every_round():
+    topo = build_topology("imp3D", 27, seed=1)
+    state, step = make(topo)
+    s0, w0 = mass(state)
+    for _ in range(100):
+        state = step(state)
+        s, w = mass(state)
+        np.testing.assert_allclose(float(s), float(s0), rtol=1e-5)
+        np.testing.assert_allclose(float(w), float(w0), rtol=1e-5)
+    # weight total is exactly N
+    np.testing.assert_allclose(float(w), topo.num_nodes, rtol=1e-5)
+
+
+def test_ratio_converges_to_mean():
+    for name, n in [("full", 64), ("imp3D", 27), ("erdos_renyi", 64)]:
+        topo = build_topology(name, n, seed=2)
+        state, step = make(topo)
+        true_mean = (topo.num_nodes - 1) / (2.0 * topo.num_nodes)  # scaled mode
+        for _ in range(3000):
+            state = step(state)
+            if bool(pushsum_done(state)):
+                break
+        assert bool(pushsum_done(state)), f"{name} did not converge"
+        ratio = np.asarray(state.ratio)
+        np.testing.assert_allclose(ratio, true_mean, atol=5e-4)
+
+
+def test_index_value_mode_matches_reference_init():
+    """value_mode='index' reproduces the reference's s_i = i
+    (Program.fs:174,77-78): average → (N-1)/2."""
+    topo = build_topology("full", 32)
+    state, step = make(topo, value_mode="index")
+    for _ in range(2000):
+        state = step(state)
+        if bool(pushsum_done(state)):
+            break
+    np.testing.assert_allclose(np.asarray(state.ratio), (32 - 1) / 2.0, rtol=1e-3)
+
+
+def test_streak_resets_on_large_delta():
+    """Directly verify the intended predicate (Program.fs:116-123 minus the
+    commit-before-compare bug): streak advances iff |Δ(s/w)| <= eps."""
+    topo = build_topology("line", 16)
+    state, step = make(topo, eps=1e-10, streak_target=3)
+    prev_ratio = np.asarray(state.ratio)
+    state = step(state)
+    delta = np.abs(np.asarray(state.ratio) - prev_ratio)
+    st = np.asarray(state.streak)
+    assert (st[delta > 1e-10] == 0).all()
+    assert (st[delta <= 1e-10] == 1).all()
+    # and some nodes did move in round 1 on a line
+    assert (delta > 1e-10).any()
+
+
+def test_reference_semantics_converges_fast():
+    """Reference mode: streak starts at 1 and increments on every round with
+    incoming mass — nodes 'converge' after ~2 received messages
+    (SURVEY.md §2.4.2)."""
+    topo = build_topology("full", 64)
+    state, step = make(topo, reference_semantics=True)
+    rounds = 0
+    for _ in range(50):
+        state = step(state)
+        rounds += 1
+        if bool(pushsum_done(state)):
+            break
+    assert bool(pushsum_done(state))
+    assert rounds <= 10  # far faster than the intended predicate
+
+
+def test_fault_preserves_alive_mass():
+    topo = build_topology("full", 32)
+    state, step = make(topo)
+    dead = np.array([1, 5])
+    state = state._replace(alive=state.alive.at[dead].set(False))
+    alive = np.asarray(state.alive)
+    s_alive0 = float(np.asarray(state.s)[alive].sum())
+    for _ in range(100):
+        state = step(state)
+    s_alive = float(np.asarray(state.s)[alive].sum())
+    np.testing.assert_allclose(s_alive, s_alive0, rtol=1e-5)
+    # dead nodes' mass is frozen, not lost
+    np.testing.assert_allclose(
+        np.asarray(state.s)[dead], np.asarray(pushsum_init(32).s)[dead], rtol=1e-6
+    )

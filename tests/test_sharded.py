@@ -1,0 +1,107 @@
+"""Multi-chip tests on the simulated 8-device CPU mesh (SURVEY.md §4.4):
+single-device vs sharded equivalence, padding correctness, psum predicate."""
+
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.parallel import (
+    make_mesh,
+    padded_size,
+    run_simulation_sharded,
+)
+
+
+def mesh8(cpu_devices):
+    return make_mesh(devices=cpu_devices[:8])
+
+
+def test_padded_size():
+    assert padded_size(27, 8) == 32
+    assert padded_size(32, 8) == 32
+    assert padded_size(1, 8) == 8
+
+
+def test_gossip_sharded_bitwise_matches_single(cpu_devices):
+    """Sharding invariance: per-node draws key on global ids, so the
+    8-device trajectory is bitwise-identical to the 1-device one."""
+    topo = build_topology("imp3D", 27, seed=2)
+    cfg = RunConfig(algorithm="gossip", seed=5, chunk_rounds=32)
+    r1 = run_simulation(topo, cfg)
+    r8 = run_simulation_sharded(topo, cfg, mesh=mesh8(cpu_devices))
+    assert r1.rounds == r8.rounds
+    assert np.array_equal(np.asarray(r1.final_state.counts),
+                          np.asarray(r8.final_state.counts))
+    assert r8.converged
+
+
+def test_pushsum_sharded_matches_single(cpu_devices):
+    """Float scatter-sums reorder across shards; trajectories agree to
+    float32 tolerance and both satisfy the invariants."""
+    topo = build_topology("erdos_renyi", 96, avg_degree=8.0, seed=3)
+    cfg = RunConfig(algorithm="push-sum", seed=7, chunk_rounds=64)
+    r1 = run_simulation(topo, cfg)
+    r8 = run_simulation_sharded(topo, cfg, mesh=mesh8(cpu_devices))
+    assert r8.converged
+    np.testing.assert_allclose(np.asarray(r1.final_state.ratio),
+                               np.asarray(r8.final_state.ratio), atol=1e-5)
+    # mass conserved in the sharded run (phantom rows contribute nothing)
+    np.testing.assert_allclose(float(np.asarray(r8.final_state.w).sum()),
+                               topo.num_nodes, rtol=1e-5)
+
+
+def test_sharded_padding_rows_inert(cpu_devices):
+    """27 nodes over 8 shards pads to 32; the 5 phantom rows must not
+    converge the predicate early or receive hits."""
+    topo = build_topology("3D", 27)
+    cfg = RunConfig(algorithm="gossip", seed=1, chunk_rounds=16)
+    res = run_simulation_sharded(topo, cfg, mesh=mesh8(cpu_devices))
+    assert res.converged
+    assert res.num_nodes == 27
+    counts = np.asarray(res.final_state.counts)
+    assert counts.shape == (27,)
+    assert (counts >= 10).all()
+
+
+def test_sharded_full_topology_implicit(cpu_devices):
+    topo = build_topology("full", 64)
+    cfg = RunConfig(algorithm="gossip", seed=4, chunk_rounds=32)
+    r1 = run_simulation(topo, cfg)
+    r8 = run_simulation_sharded(topo, cfg, mesh=mesh8(cpu_devices))
+    assert r8.converged
+    assert r1.rounds == r8.rounds
+
+
+def test_sharded_fault_injection(cpu_devices):
+    topo = build_topology("full", 64)
+    # deterministic plan that spares the seed node (node 0)
+    plan = {0: np.arange(16, 32)}
+    cfg = RunConfig(algorithm="gossip", seed=9, seed_node=0,
+                    fault_plan=plan, chunk_rounds=32)
+    res = run_simulation_sharded(topo, cfg, mesh=mesh8(cpu_devices))
+    assert res.converged
+    assert res.metrics[-1]["alive"] == 48
+
+
+def test_sharded_stall_detection_when_seed_dies(cpu_devices):
+    """Killing the rumor source before it spreads makes gossip hopeless;
+    the driver must stall out immediately instead of grinding to
+    max_rounds (which is what an actor system with a dead seed would do:
+    hang forever, SURVEY.md §5.3)."""
+    topo = build_topology("full", 64)
+    cfg = RunConfig(algorithm="gossip", seed=9, seed_node=3,
+                    fault_plan={0: np.array([3])}, chunk_rounds=32)
+    res = run_simulation_sharded(topo, cfg, mesh=mesh8(cpu_devices))
+    assert not res.converged
+    assert res.rounds <= 32
+    assert res.metrics[-1].get("stalled") is True
+
+
+@pytest.mark.parametrize("num_devices", [2, 4])
+def test_mesh_sizes(cpu_devices, num_devices):
+    topo = build_topology("line", 32)
+    cfg = RunConfig(algorithm="gossip", seed=0, chunk_rounds=64)
+    res = run_simulation_sharded(
+        topo, cfg, mesh=make_mesh(devices=cpu_devices[:num_devices])
+    )
+    assert res.converged

@@ -1,0 +1,128 @@
+"""Topology unit tests (SURVEY.md §4.1): degree distributions, adjacency
+symmetry, line endpoints, Imp3D = 3D + 1 extra, cube rounding."""
+
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu.topology import (
+    Topology,
+    build_topology,
+    build_line,
+    build_full,
+    build_grid3d,
+    build_imp3d,
+    build_erdos_renyi,
+    build_power_law,
+    cube_side,
+    csr_from_edges,
+    available_topologies,
+)
+
+
+def adjacency_set(topo: Topology):
+    return {
+        (i, int(j)) for i in range(topo.num_nodes) for j in topo.neighbors_of(i)
+    }
+
+
+def assert_symmetric(topo: Topology):
+    adj = adjacency_set(topo)
+    assert all((j, i) in adj for (i, j) in adj), "adjacency not symmetric"
+
+
+def test_line_shape():
+    t = build_line(10)
+    t.validate()
+    deg = t.degree
+    # endpoints have one neighbor (Program.fs:184-189), interior two
+    assert deg[0] == 1 and deg[-1] == 1
+    assert (deg[1:-1] == 2).all()
+    assert set(t.neighbors_of(0)) == {1}
+    assert set(t.neighbors_of(5)) == {4, 6}
+    assert_symmetric(t)
+
+
+def test_full_is_implicit():
+    t = build_full(1000)
+    assert t.implicit_full
+    assert (t.degree == 999).all()
+    assert set(build_full(4).neighbors_of(2)) == {0, 1, 3}
+
+
+def test_cube_side_rounds_up():
+    # reference: ceil(cbrt n)**3 (Program.fs:239-240)
+    assert cube_side(27) == 3
+    assert cube_side(28) == 4
+    assert cube_side(8) == 2
+    assert cube_side(1000) == 10
+    assert cube_side(1001) == 11
+
+
+def test_grid3d_adjacency():
+    t = build_grid3d(27)
+    t.validate()
+    assert t.num_nodes == 27
+    deg = t.degree
+    # corner nodes degree 3, center degree 6 in a 3x3x3 lattice
+    assert deg[0] == 3
+    center = 1 * 9 + 1 * 3 + 1
+    assert deg[center] == 6
+    assert set(t.neighbors_of(center)) == {center - 9, center + 9,
+                                           center - 3, center + 3,
+                                           center - 1, center + 1}
+    assert_symmetric(t)
+    # rounding up: request 28 -> 64 nodes
+    assert build_grid3d(28).num_nodes == 64
+
+
+def test_imp3d_is_3d_plus_extra():
+    base = build_grid3d(64)
+    imp = build_imp3d(64, seed=3)
+    imp.validate()
+    assert imp.num_nodes == 64
+    a3 = adjacency_set(base)
+    ai = adjacency_set(imp)
+    assert a3 <= ai, "imp3D must contain every lattice edge"
+    extra = ai - a3
+    assert len(extra) >= 1
+    # each node gains at most a few extra edges (its own draw + incoming)
+    assert_symmetric(imp)
+    # every node has at least lattice degree
+    assert (imp.degree >= base.degree).all()
+
+
+def test_erdos_renyi_degree():
+    t = build_erdos_renyi(2000, avg_degree=10.0, seed=0)
+    t.validate()
+    assert_symmetric(t)
+    mean_deg = t.degree.mean()
+    assert 8.0 < mean_deg < 11.0  # dedup trims slightly below 10
+
+
+def test_power_law_tail():
+    t = build_power_law(3000, m=4, seed=0)
+    t.validate()
+    assert_symmetric(t)
+    deg = np.sort(t.degree)[::-1]
+    assert deg.min() >= 1
+    # heavy tail: the top hub is far above the mean
+    assert deg[0] > 5 * deg.mean()
+
+
+def test_csr_dedup_and_self_loops():
+    edges = np.array([[0, 1], [1, 0], [0, 1], [2, 2], [1, 2]])
+    t = csr_from_edges(3, edges, kind="test")
+    t.validate()
+    assert set(t.neighbors_of(0)) == {1}
+    assert set(t.neighbors_of(1)) == {0, 2}
+    assert set(t.neighbors_of(2)) == {1}
+
+
+def test_registry_dispatch_and_aliases():
+    assert build_topology("imp3d", 8).kind == "imp3D"
+    assert build_topology("er", 100, avg_degree=4.0).kind == "erdos_renyi"
+    assert "power_law" in available_topologies()
+    # unknown topology raises (reference silently no-ops, Program.fs:279 —
+    # documented behavioral improvement)
+    with pytest.raises(ValueError, match="unknown topology"):
+        build_topology("mobius", 10)
