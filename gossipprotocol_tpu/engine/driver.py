@@ -108,6 +108,11 @@ class RunResult:
         The reference mean is computed over *healthy* rows only: a dead
         node's mass is stranded (SURVEY.md §5.3 semantics), so the mean the
         survivors can reach is sum_alive(s)/sum_alive(w).
+
+        Only meaningful on *connected* topologies: push-sum provably
+        averages within each connected component, so on a graph with
+        stragglers (e.g. sparse Erdős–Rényi with isolated pairs) this
+        reports the gap between component means, not a protocol error.
         """
         st = self.final_state
         if not isinstance(st, PushSumState):

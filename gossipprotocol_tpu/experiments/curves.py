@@ -1,0 +1,90 @@
+"""Reproduce the reference's benchmark curves (Report.pdf p.1-2).
+
+The reference's published evidence is two hand-made graphs: convergence
+time vs node count for the four topologies, one graph per algorithm
+(BASELINE.md). This tool sweeps the same grid and emits a CSV (plus an
+optional JSON summary) so the curves can be regenerated mechanically:
+
+    python -m gossipprotocol_tpu.experiments.curves \
+        --nodes 100,250,500,750,1000 --out curves.csv
+
+Columns: algorithm, topology, nodes_requested, nodes_actual, rounds,
+wall_ms, compile_ms, converged, estimate_error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+
+DEFAULT_NODES = "100,250,500,750,1000"
+DEFAULT_TOPOLOGIES = "line,full,3D,imp3D"
+DEFAULT_ALGORITHMS = "gossip,push-sum"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="curves")
+    p.add_argument("--nodes", default=DEFAULT_NODES)
+    p.add_argument("--topologies", default=DEFAULT_TOPOLOGIES)
+    p.add_argument("--algorithms", default=DEFAULT_ALGORITHMS)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--repeats", type=int, default=1,
+                   help="runs per point; wall_ms reports the minimum")
+    p.add_argument("--semantics", choices=["intended", "reference"],
+                   default="intended")
+    p.add_argument("--out", default="curves.csv")
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args(argv)
+
+    from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+
+    nodes_list = [int(x) for x in args.nodes.split(",")]
+    topologies = args.topologies.split(",")
+    algorithms = args.algorithms.split(",")
+
+    rows = []
+    for algo in algorithms:
+        for topo_name in topologies:
+            for n in nodes_list:
+                topo = build_topology(topo_name, n, seed=args.seed)
+                best = None
+                for r in range(args.repeats):
+                    cfg = RunConfig(
+                        algorithm=algo, seed=args.seed + r,
+                        semantics=args.semantics, chunk_rounds=4096,
+                        max_rounds=500_000,
+                    )
+                    res = run_simulation(topo, cfg)
+                    if best is None or res.wall_ms < best.wall_ms:
+                        best = res
+                row = {
+                    "algorithm": algo,
+                    "topology": topo_name,
+                    "nodes_requested": n,
+                    "nodes_actual": topo.num_nodes,
+                    "rounds": best.rounds,
+                    "wall_ms": round(best.wall_ms, 3),
+                    "compile_ms": round(best.compile_ms, 1),
+                    "converged": best.converged,
+                    "estimate_error": best.estimate_error,
+                }
+                rows.append(row)
+                print(f"{algo:9s} {topo_name:6s} n={n:7d} -> "
+                      f"{row['wall_ms']:10.1f} ms  ({row['rounds']} rounds)",
+                      file=sys.stderr)
+
+    with open(args.out, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=list(rows[0].keys()))
+        w.writeheader()
+        w.writerows(rows)
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(rows, fh, indent=2)
+    print(f"wrote {len(rows)} points to {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

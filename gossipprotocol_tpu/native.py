@@ -1,0 +1,111 @@
+"""ctypes loader for the native graph-construction kernels.
+
+``native/graphgen.cpp`` implements the two host-side hot paths of topology
+assembly (canonical CSR build, Barabási–Albert generation) with the same
+splitmix64 stream as the numpy fallbacks — same seed, bitwise-identical
+graph either way (asserted by tests/test_native.py). The library is
+optional: everything works without it, just slower at 10M+ nodes.
+
+Build:  ``make -C native``  (or ``python -m gossipprotocol_tpu.native``).
+Disable: ``GOSSIP_TPU_NATIVE=0``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libgraphgen.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("GOSSIP_TPU_NATIVE", "1") == "0":
+        return None
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.csr_build.restype = ctypes.c_int64
+    lib.csr_build.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, i64p, i64p, i64p, i32p,
+    ]
+    lib.ba_edges.restype = ctypes.c_int64
+    lib.ba_edges.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, i64p, i64p,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_library(quiet: bool = True) -> str:
+    """Compile native/libgraphgen.so in place (requires g++)."""
+    global _load_attempted, _lib
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR],
+        check=True,
+        capture_output=quiet,
+    )
+    _load_attempted = False
+    _lib = None
+    if _load() is None:
+        raise RuntimeError(f"built {_LIB_PATH} but failed to load it")
+    return _LIB_PATH
+
+
+def csr_build(
+    num_nodes: int, src: np.ndarray, dst: np.ndarray
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Canonical symmetric CSR from an undirected edge list, or None if the
+    native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src, dtype=np.int64)
+    dst = np.ascontiguousarray(dst, dtype=np.int64)
+    e = len(src)
+    offsets = np.empty(num_nodes + 1, dtype=np.int64)
+    indices = np.empty(max(2 * e, 1), dtype=np.int32)
+    nnz = lib.csr_build(num_nodes, e, src, dst, offsets, indices)
+    if nnz < 0:
+        raise ValueError("csr_build: edge index out of range")
+    return offsets, indices[:nnz].copy()
+
+
+def ba_edges(num_nodes: int, m: int, seed: int) -> Optional[np.ndarray]:
+    """Barabási–Albert edge list [E, 2], or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    cap = (m + 1) * m // 2 + max(num_nodes - m - 1, 0) * m
+    src = np.empty(cap, dtype=np.int64)
+    dst = np.empty(cap, dtype=np.int64)
+    ne = lib.ba_edges(num_nodes, m, np.uint64(seed & (2**64 - 1)).item(), src, dst)
+    if ne < 0:
+        raise ValueError("ba_edges: invalid n/m")
+    return np.stack([src[:ne], dst[:ne]], axis=1)
+
+
+if __name__ == "__main__":
+    print(build_library(quiet=False))
+    print("native kernels available:", available())

@@ -107,30 +107,46 @@ class Topology:
 def csr_from_edges(num_nodes: int, edges: np.ndarray, kind: str) -> Topology:
     """Build a symmetric CSR Topology from an undirected edge list [E, 2].
 
-    Deduplicates repeated edges and drops self-loops so every builder yields
-    a simple graph.
+    Deduplicates repeated edges and drops self-loops so every builder
+    yields a simple graph. The CSR is *canonical* — each row's neighbors
+    sorted ascending — so the numpy and native C++ builders produce
+    bitwise-identical topologies (and therefore identical simulation
+    trajectories) for the same edge multiset.
     """
+    if num_nodes > 2**31 - 1:
+        raise ValueError(
+            f"num_nodes={num_nodes} exceeds int32 CSR index range"
+        )
     edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
-    # drop self-loops
-    edges = edges[edges[:, 0] != edges[:, 1]]
-    # canonicalize (lo, hi) and dedup
-    lo = np.minimum(edges[:, 0], edges[:, 1])
-    hi = np.maximum(edges[:, 0], edges[:, 1])
-    key = lo * num_nodes + hi
-    _, uniq = np.unique(key, return_index=True)
-    lo, hi = lo[uniq], hi[uniq]
-    # symmetrize: each undirected edge contributes both directions
-    src = np.concatenate([lo, hi])
-    dst = np.concatenate([hi, lo])
-    order = np.argsort(src, kind="stable")
-    src, dst = src[order], dst[order]
-    counts = np.bincount(src, minlength=num_nodes)
-    offsets = np.zeros(num_nodes + 1, dtype=np.int64)
-    np.cumsum(counts, out=offsets[1:])
-    itype = np.int32 if len(dst) < 2**31 else np.int64
+
+    from gossipprotocol_tpu import native
+
+    built = native.csr_build(num_nodes, edges[:, 0], edges[:, 1])
+    if built is not None:
+        offsets, indices = built
+    else:
+        # numpy fallback — produces the identical canonical CSR
+        # drop self-loops
+        edges = edges[edges[:, 0] != edges[:, 1]]
+        # symmetrize: each undirected edge contributes both directions
+        src = np.concatenate([edges[:, 0], edges[:, 1]])
+        dst = np.concatenate([edges[:, 1], edges[:, 0]])
+        # canonical order (src, dst) ascending, dedup directed pairs —
+        # this both dedups undirected duplicates and sorts every CSR row
+        key = np.unique(src * np.int64(num_nodes) + dst)
+        src = key // num_nodes
+        dst = key % num_nodes
+        counts = np.bincount(src, minlength=num_nodes)
+        offsets = np.zeros(num_nodes + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        indices = dst.astype(np.int32)
+
+    # one dtype policy for both branches: offsets compact to int32 when
+    # the directed-edge count allows it
+    otype = np.int32 if len(indices) < 2**31 else np.int64
     return Topology(
         kind=kind,
         num_nodes=num_nodes,
-        offsets=offsets.astype(itype),
-        indices=dst.astype(np.int32),
+        offsets=offsets.astype(otype),
+        indices=indices,
     )

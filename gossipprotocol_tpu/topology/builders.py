@@ -30,11 +30,10 @@ Two additional random families, per the BASELINE.json north-star configs
 
 from __future__ import annotations
 
-import math
-
 import numpy as np
 
 from gossipprotocol_tpu.topology.base import Topology, csr_from_edges
+from gossipprotocol_tpu.utils.prng import uniform_int
 
 
 def build_line(num_nodes: int) -> Topology:
@@ -93,10 +92,9 @@ def build_imp3d(num_nodes: int, seed: int = 0) -> Topology:
     divergence from the reference's off-by-one range)."""
     g = cube_side(num_nodes)
     n = g**3
-    rng = np.random.default_rng(seed)
-    extra_dst = rng.integers(0, n - 1, size=n, dtype=np.int64)
     src = np.arange(n, dtype=np.int64)
-    extra_dst = extra_dst + (extra_dst >= src)  # uniform over [0, n) \ {i}
+    r = uniform_int(seed, src, n - 1)
+    extra_dst = r + (r >= src)  # uniform over [0, n) \ {i}
     extra = np.stack([src, extra_dst], axis=1)
     edges = np.concatenate([_grid3d_edges(g), extra], axis=0)
     topo = csr_from_edges(n, edges, kind="imp3D")
@@ -113,11 +111,11 @@ def build_erdos_renyi(num_nodes: int, avg_degree: float = 8.0, seed: int = 0) ->
     """
     if num_nodes < 2:
         raise ValueError("erdos_renyi needs >= 2 nodes")
-    rng = np.random.default_rng(seed)
     m = int(round(avg_degree * num_nodes / 2.0))
     m = min(m, num_nodes * (num_nodes - 1) // 2)
-    src = rng.integers(0, num_nodes, size=m, dtype=np.int64)
-    dst = rng.integers(0, num_nodes, size=m, dtype=np.int64)
+    k = np.arange(m, dtype=np.uint64)
+    src = uniform_int(seed, 2 * k, num_nodes)
+    dst = uniform_int(seed, 2 * k + 1, num_nodes)
     edges = np.stack([src, dst], axis=1)
     return csr_from_edges(num_nodes, edges, kind="erdos_renyi")
 
@@ -134,7 +132,14 @@ def build_power_law(num_nodes: int, m: int = 4, seed: int = 0) -> Topology:
     """
     if num_nodes < m + 1:
         raise ValueError("power_law needs num_nodes > m")
-    rng = np.random.default_rng(seed)
+
+    from gossipprotocol_tpu import native
+
+    native_edges = native.ba_edges(num_nodes, m, seed)
+    if native_edges is not None:
+        return csr_from_edges(num_nodes, native_edges, kind="power_law")
+
+    # numpy fallback — identical draws (shared splitmix64 stream)
     # seed clique on m+1 nodes
     seed_nodes = np.arange(m + 1, dtype=np.int64)
     si, sj = np.triu_indices(m + 1, k=1)
@@ -144,13 +149,17 @@ def build_power_law(num_nodes: int, m: int = 4, seed: int = 0) -> Topology:
 
     start = m + 1
     chunk = max(1024, (num_nodes - start) // 64 or 1)
+    draw_counter = 0  # global splitmix counter — keep in lockstep with C++
     while start < num_nodes:
         stop = min(start + chunk, num_nodes)
         new = np.arange(start, stop, dtype=np.int64)
         # each new node draws m endpoints (∝ degree at chunk start)
-        draws = endpoints[rng.integers(0, len(endpoints), size=(len(new), m))]
+        n_draws = len(new) * m
+        counters = np.arange(draw_counter, draw_counter + n_draws, dtype=np.uint64)
+        draw_counter += n_draws
+        draws = endpoints[uniform_int(seed, counters, len(endpoints))]
         src = np.repeat(new, m)
-        dst = draws.ravel()
+        dst = draws
         edge_src.append(src)
         edge_dst.append(dst)
         endpoints = np.concatenate([endpoints, src, dst])
