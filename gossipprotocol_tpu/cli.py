@@ -55,12 +55,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="push-sum: consecutive small-delta rounds (Program.fs:121)")
     p.add_argument("--semantics", choices=["intended", "reference"],
                    default="intended")
+    p.add_argument("--predicate", choices=["delta", "global"], default="delta",
+                   help="push-sum convergence rule: the reference's intended "
+                        "local delta streak, or the sound global "
+                        "|s/w - mean| <= tol test (mean known by mass "
+                        "conservation)")
+    p.add_argument("--tol", type=float, default=1e-4,
+                   help="tolerance for --predicate global")
     p.add_argument("--value-mode", choices=["scaled", "index"], default="scaled",
                    help="push-sum init: i/N (TPU-safe) or the reference's s_i=i")
+    p.add_argument("--x64", action="store_true",
+                   help="push-sum in float64 (enables jax x64; slower on TPU; "
+                        "for numerics — note the delta predicate's early "
+                        "firing on slow mixers is intrinsic, not a precision "
+                        "artifact; use --predicate global for soundness)")
     p.add_argument("--no-keep-alive", action="store_true",
                    help="disable the Actor2-style rumor keep-alive (Program.fs:141-163)")
     p.add_argument("--max-rounds", type=int, default=1_000_000)
-    p.add_argument("--chunk-rounds", type=int, default=512)
+    p.add_argument("--chunk-rounds", type=int, default=None,
+                   help="rounds per device call (default: auto by node count)")
     p.add_argument("--seed-node", type=int, default=None)
     p.add_argument("--avg-degree", type=float, default=8.0,
                    help="erdos_renyi mean degree")
@@ -90,6 +103,9 @@ def main(argv=None) -> int:
     import os
 
     import jax
+
+    if args.x64:
+        jax.config.update("jax_enable_x64", True)
 
     if args.backend != "auto":
         # This image's sitecustomize pre-imports jax, so flipping
@@ -139,14 +155,19 @@ def main(argv=None) -> int:
             topo.num_nodes, args.fail_fraction, args.fail_round, seed=args.seed
         )
 
+    import jax.numpy as jnp
+
     cfg = RunConfig(
         algorithm=algo,
+        dtype=jnp.float64 if args.x64 else jnp.float32,
         seed=args.seed,
         threshold=args.threshold,
         eps=args.eps,
         streak_target=args.streak,
         keep_alive=not args.no_keep_alive,
         semantics=args.semantics,
+        predicate=args.predicate,
+        tol=args.tol,
         value_mode=args.value_mode,
         max_rounds=args.max_rounds,
         chunk_rounds=args.chunk_rounds,

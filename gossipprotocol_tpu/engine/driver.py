@@ -68,10 +68,16 @@ class RunConfig:
     streak_target: int = 3         # consecutive small-delta rounds (Program.fs:121)
     keep_alive: bool = True        # bulk-sync analogue of Actor2 (Program.fs:141-163)
     semantics: str = "intended"    # "intended" | "reference"
+    predicate: str = "delta"       # push-sum: "delta" (reference-intended,
+                                   # local) | "global" (sound; see pushsum.py)
+    tol: float = 1e-4              # push-sum global-predicate tolerance
     value_mode: str = "scaled"     # push-sum init: "scaled" (i/N) | "index" (i)
     dtype: Any = jnp.float32
     max_rounds: int = 1_000_000
-    chunk_rounds: int = 512        # rounds per jitted call / metrics cadence
+    # rounds per jitted call / metrics cadence; None = auto-scale by node
+    # count so one on-device chunk stays well under remote-execution
+    # watchdogs (~minutes) while amortizing dispatch overhead
+    chunk_rounds: Optional[int] = None
     seed_node: Optional[int] = None  # gossip start node; None = random (Program.fs:193)
     # aux subsystems
     metrics_callback: Optional[Callable[[dict], None]] = None
@@ -87,6 +93,21 @@ class RunConfig:
             )
         if self.semantics not in ("intended", "reference"):
             raise ValueError("semantics must be 'intended' or 'reference'")
+        if self.predicate not in ("delta", "global"):
+            raise ValueError("predicate must be 'delta' or 'global'")
+        if self.predicate == "global" and self.semantics == "reference":
+            raise ValueError(
+                "predicate='global' is incompatible with semantics='reference' "
+                "(the reference's accidental rule ignores the estimate entirely)"
+            )
+
+    def resolve_chunk_rounds(self, num_nodes: int) -> int:
+        """Auto chunk size: target ~30 s of on-device work per chunk at an
+        observed ~100 ns/node/round, clamped to [32, 4096]."""
+        if self.chunk_rounds is not None:
+            return self.chunk_rounds
+        est = int(3e8 / max(num_nodes, 1))
+        return max(32, min(4096, est))
 
 
 @dataclasses.dataclass
@@ -183,6 +204,8 @@ def build_protocol(topo: Topology, cfg: RunConfig, num_rows: Optional[int] = Non
             eps=cfg.eps,
             streak_target=cfg.streak_target,
             reference_semantics=ref,
+            predicate=cfg.predicate,
+            tol=cfg.tol,
         )
         done_fn = pushsum_done
         extra_stats = None
@@ -281,6 +304,7 @@ def _drive(
     from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
 
     fault_plan = {int(k): v for k, v in (cfg.fault_plan or {}).items()}
+    chunk_rounds = cfg.resolve_chunk_rounds(topo.num_nodes)
     metrics: List[dict] = []
     checkpoints: List[str] = []
     chunk_i = 0
@@ -299,7 +323,7 @@ def _drive(
             state = state._replace(alive=state.alive.at[ids].set(False))
 
         next_fault = min(fault_plan, default=cfg.max_rounds)
-        round_limit = min(cur_round + cfg.chunk_rounds, cfg.max_rounds, next_fault)
+        round_limit = min(cur_round + chunk_rounds, cfg.max_rounds, next_fault)
 
         state, stats = step(state, round_limit)
         chunk_i += 1

@@ -22,45 +22,131 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libgraphgen.so")
+_ASYNC_LIB_PATH = os.path.join(_NATIVE_DIR, "libasyncsim.so")
 
-_lib: Optional[ctypes.CDLL] = None
-_load_attempted = False
+_I64P = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 
 
-def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _load_attempted
-    if _load_attempted:
-        return _lib
-    _load_attempted = True
-    if os.environ.get("GOSSIP_TPU_NATIVE", "1") == "0":
-        return None
-    if not os.path.exists(_LIB_PATH):
-        return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
-        return None
-    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
-    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+def _configure_graphgen(lib: ctypes.CDLL) -> None:
     lib.csr_build.restype = ctypes.c_int64
     lib.csr_build.argtypes = [
-        ctypes.c_int64, ctypes.c_int64, i64p, i64p, i64p, i32p,
+        ctypes.c_int64, ctypes.c_int64, _I64P, _I64P, _I64P, _I32P,
     ]
     lib.ba_edges.restype = ctypes.c_int64
     lib.ba_edges.argtypes = [
-        ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, i64p, i64p,
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, _I64P, _I64P,
     ]
-    _lib = lib
-    return _lib
+
+
+def _configure_asyncsim(lib: ctypes.CDLL) -> None:
+    lib.async_gossip.restype = ctypes.c_int64
+    lib.async_gossip.argtypes = [
+        ctypes.c_int64, _I64P, _I32P, ctypes.c_uint64, ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.async_pushsum_walk.restype = ctypes.c_int64
+    lib.async_pushsum_walk.argtypes = [
+        ctypes.c_int64, _I64P, _I32P, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+
+
+# path -> (loaded-or-None, attempted) — one loading policy for all libs
+_libs: dict = {}
+
+
+def _load_shared(path: str, configure) -> Optional[ctypes.CDLL]:
+    if path in _libs:
+        return _libs[path]
+    if os.environ.get("GOSSIP_TPU_NATIVE", "1") == "0" or not os.path.exists(path):
+        _libs[path] = None
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        configure(lib)
+    except OSError:
+        lib = None
+    _libs[path] = lib
+    return lib
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    return _load_shared(_LIB_PATH, _configure_graphgen)
+
+
+def _load_async() -> Optional[ctypes.CDLL]:
+    return _load_shared(_ASYNC_LIB_PATH, _configure_asyncsim)
 
 
 def available() -> bool:
     return _load() is not None
 
 
+def async_available() -> bool:
+    return _load_async() is not None
+
+
+def _topo_csr64(topo):
+    if topo.implicit_full:
+        # materialize K_n for the oracle (small-n cross-validation only)
+        n = topo.num_nodes
+        if n > 20_000:
+            raise ValueError("oracle on implicit full topology: n too large")
+        ids = np.arange(n, dtype=np.int32)
+        indices = np.ascontiguousarray(
+            np.stack([np.delete(ids, i) for i in range(n)]).reshape(-1)
+        )
+        offsets = np.arange(0, n * (n - 1) + 1, n - 1, dtype=np.int64)
+        return offsets, indices
+    offsets = np.ascontiguousarray(topo.offsets, dtype=np.int64)
+    indices = np.ascontiguousarray(topo.indices, dtype=np.int32)
+    return offsets, indices
+
+
+def async_gossip_events(
+    topo, seed: int, threshold: int = 11, start_node: int = 0,
+    max_events: int = 100_000_000,
+) -> Optional[int]:
+    """Message events to global convergence under the reference's *actor*
+    semantics (asynchronous oracle; see native/asyncsim.cpp). None if the
+    oracle library is unavailable; raises if convergence is not reached
+    within max_events."""
+    lib = _load_async()
+    if lib is None:
+        return None
+    offsets, indices = _topo_csr64(topo)
+    ev = lib.async_gossip(
+        topo.num_nodes, offsets, indices, np.uint64(seed & (2**64 - 1)).item(),
+        threshold, start_node, max_events,
+    )
+    if ev < 0:
+        raise RuntimeError("async_gossip: no convergence within max_events")
+    return int(ev)
+
+
+def async_pushsum_hops(
+    topo, seed: int, start_node: int = 0, max_hops: int = 1_000_000_000
+) -> Optional[int]:
+    """Hops of the reference's single-token push-sum walk until every node
+    'converges' on its 2nd receipt (SURVEY.md §2.4.2 — the 2-cover time).
+    None if unavailable; raises on non-convergence."""
+    lib = _load_async()
+    if lib is None:
+        return None
+    offsets, indices = _topo_csr64(topo)
+    hops = lib.async_pushsum_walk(
+        topo.num_nodes, offsets, indices, np.uint64(seed & (2**64 - 1)).item(),
+        start_node, max_hops,
+    )
+    if hops < 0:
+        raise RuntimeError("async_pushsum_walk: trapped or max_hops reached")
+    return int(hops)
+
+
 def build_library(quiet: bool = True) -> str:
-    """Compile native/libgraphgen.so in place (requires g++)."""
-    global _load_attempted, _lib
+    """Compile the native libraries in place (requires g++)."""
+    global _load_attempted, _lib, _async_load_attempted, _async_lib
     subprocess.run(
         ["make", "-C", _NATIVE_DIR],
         check=True,
@@ -68,6 +154,8 @@ def build_library(quiet: bool = True) -> str:
     )
     _load_attempted = False
     _lib = None
+    _async_load_attempted = False
+    _async_lib = None
     if _load() is None:
         raise RuntimeError(f"built {_LIB_PATH} but failed to load it")
     return _LIB_PATH

@@ -1,5 +1,6 @@
 from gossipprotocol_tpu.parallel.mesh import (
     NODES_AXIS,
+    initialize_distributed,
     make_mesh,
     node_sharding,
     padded_size,
@@ -12,6 +13,7 @@ from gossipprotocol_tpu.parallel.sharded import (
 
 __all__ = [
     "NODES_AXIS",
+    "initialize_distributed",
     "make_mesh",
     "node_sharding",
     "padded_size",

@@ -22,6 +22,33 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 NODES_AXIS = "nodes"
 
 
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-host run (the DCN analogue of the reference's never-
+    configured Akka.Remote, SURVEY.md §2.8 — here it actually works).
+
+    Call once per host before ``make_mesh()``; afterwards ``jax.devices()``
+    spans every host, the 1-D ``"nodes"`` mesh covers all chips, and the
+    same ``shard_map`` engine runs unchanged — ``psum_scatter`` rides ICI
+    within a host and DCN across hosts, with XLA picking the routing.
+    Arguments default to cluster auto-detection (GKE/Cloud TPU metadata);
+    pass them explicitly elsewhere.
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
 def make_mesh(
     num_devices: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,

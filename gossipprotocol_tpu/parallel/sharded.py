@@ -77,6 +77,9 @@ def _sharded_core(topo: Topology, cfg: RunConfig):
         eps=cfg.eps,
         streak_target=cfg.streak_target,
         reference_semantics=ref,
+        predicate=cfg.predicate,
+        tol=cfg.tol,
+        all_sum=lambda x: jax.lax.psum(jnp.sum(x), NODES_AXIS),
     )
 
 

@@ -1,0 +1,45 @@
+"""Golden round-count tests (SURVEY.md §4.3: "small-N deterministic-seed
+runs with golden round counts").
+
+Gossip trajectories are integer + counter-based threefry, so the round
+count is exact and backend/sharding-invariant — pinned hard. Push-sum is
+float32; its trajectory is deterministic on a given backend but rounding
+may differ across XLA backends/versions, so it is pinned to a band.
+
+If a deliberate change to sampling or protocol semantics moves these
+numbers, update the table in the same commit and say why.
+"""
+
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+
+# (topology, n) -> (gossip_rounds_exact, pushsum_rounds_center)
+GOLDEN = {
+    ("line", 64): (113, 193),
+    ("full", 128): (28, 87),
+    ("3D", 64): (29, 149),
+    ("imp3D", 64): (25, 124),
+    ("erdos_renyi", 128): (49, 111),
+    ("power_law", 128): (575, 649),
+}
+
+
+@pytest.mark.parametrize("key", sorted(GOLDEN), ids=lambda k: f"{k[0]}-{k[1]}")
+def test_golden_rounds(key):
+    name, n = key
+    gossip_gold, pushsum_gold = GOLDEN[key]
+    topo = build_topology(name, n, seed=11)
+
+    g = run_simulation(topo, RunConfig(algorithm="gossip", seed=42))
+    assert g.converged
+    assert g.rounds == gossip_gold, (
+        f"gossip {name}@{n}: {g.rounds} != golden {gossip_gold}"
+    )
+
+    p = run_simulation(topo, RunConfig(algorithm="push-sum", seed=42))
+    assert p.converged
+    lo, hi = int(pushsum_gold * 0.8), int(pushsum_gold * 1.25)
+    assert lo <= p.rounds <= hi, (
+        f"push-sum {name}@{n}: {p.rounds} outside [{lo}, {hi}]"
+    )

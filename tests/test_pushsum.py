@@ -95,6 +95,40 @@ def test_reference_semantics_converges_fast():
     assert rounds <= 10  # far faster than the intended predicate
 
 
+def test_global_predicate_sound_on_line():
+    """The delta predicate fires early on slow mixers (line: estimates far
+    from the mean when streaks complete); the global predicate only fires
+    when every node is actually within tol of the achievable mean."""
+    from gossipprotocol_tpu import RunConfig, run_simulation
+
+    topo = build_topology("line", 32)
+    delta_res = run_simulation(
+        topo, RunConfig(algorithm="push-sum", seed=3, max_rounds=50_000)
+    )
+    global_res = run_simulation(
+        topo,
+        RunConfig(algorithm="push-sum", seed=3, predicate="global", tol=1e-3,
+                  max_rounds=50_000),
+    )
+    assert global_res.converged
+    assert global_res.estimate_error < 2e-3
+    # and the delta rule really is unsound here: it stops far earlier with
+    # a much larger error
+    assert delta_res.rounds < global_res.rounds
+    assert delta_res.estimate_error > 0.01
+
+
+def test_global_predicate_sharded(cpu_devices):
+    from gossipprotocol_tpu import RunConfig
+    from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+    topo = build_topology("full", 64)
+    cfg = RunConfig(algorithm="push-sum", seed=1, predicate="global", tol=1e-4)
+    res = run_simulation_sharded(topo, cfg, mesh=make_mesh(devices=cpu_devices[:8]))
+    assert res.converged
+    assert res.estimate_error < 2e-4
+
+
 def test_fault_preserves_alive_mass():
     topo = build_topology("full", 32)
     state, step = make(topo)
