@@ -146,16 +146,15 @@ def async_pushsum_hops(
 
 def build_library(quiet: bool = True) -> str:
     """Compile the native libraries in place (requires g++)."""
-    global _load_attempted, _lib, _async_load_attempted, _async_lib
     subprocess.run(
         ["make", "-C", _NATIVE_DIR],
         check=True,
         capture_output=quiet,
     )
-    _load_attempted = False
-    _lib = None
-    _async_load_attempted = False
-    _async_lib = None
+    # a pre-build _load() caches None for a missing .so; drop stale entries
+    # so the freshly built libraries get probed again
+    _libs.pop(_LIB_PATH, None)
+    _libs.pop(_ASYNC_LIB_PATH, None)
     if _load() is None:
         raise RuntimeError(f"built {_LIB_PATH} but failed to load it")
     return _LIB_PATH

@@ -21,17 +21,24 @@ def native_lib():
 
 
 def _numpy_only():
-    """Context: force the numpy fallback paths."""
+    """Context: force the numpy fallback paths.
+
+    Patches the loader's real cache (``native._libs``: path -> CDLL|None;
+    ``_load_shared`` returns the cached entry before any env/file checks),
+    so inside the context every native entry point reports unavailable."""
     import contextlib
 
     @contextlib.contextmanager
     def ctx():
-        old = native._lib, native._load_attempted
-        native._lib, native._load_attempted = None, True
+        saved = dict(native._libs)
+        native._libs[native._LIB_PATH] = None
+        native._libs[native._ASYNC_LIB_PATH] = None
+        assert not native.available(), "numpy-only patch did not take"
         try:
             yield
         finally:
-            native._lib, native._load_attempted = old
+            native._libs.clear()
+            native._libs.update(saved)
 
     return ctx()
 
