@@ -117,6 +117,9 @@ def main(argv=None) -> int:
             print(f"backend {args.backend!r} unavailable: {e}", file=sys.stderr)
             return 2
         jax.config.update("jax_default_device", backend_devices[0])
+        backend_name = backend_devices[0].platform
+    else:
+        backend_name = jax.default_backend()
 
     from gossipprotocol_tpu.engine import RunConfig, run_simulation, resume_simulation
     from gossipprotocol_tpu.topology import build_topology
@@ -147,7 +150,51 @@ def main(argv=None) -> int:
         print(f"note: {args.topology} rounds {args.num_nodes} up to "
               f"{topo.num_nodes} nodes (Program.fs:239-240 semantics)")
 
-    writer = JsonlMetricsWriter(args.metrics_out) if args.metrics_out else None
+    state = None
+    if args.resume:
+        path = args.resume
+        if os.path.isdir(path):
+            path = ckpt.latest(path)
+            if path is None:
+                print(f"no checkpoint found in {args.resume}", file=sys.stderr)
+                return 2
+        state, meta = ckpt.load(path)
+        # a checkpoint from a different experiment would "resume" into a
+        # plausible-but-wrong run — validate before continuing (and before
+        # anything with side effects, like opening the metrics file)
+        current = {
+            "algorithm": algo,
+            "seed": args.seed,
+            "semantics": args.semantics,
+            "threshold": args.threshold,
+            "eps": args.eps,
+            "streak_target": args.streak,
+            "keep_alive": not args.no_keep_alive,
+            "predicate": args.predicate,
+            "tol": args.tol,
+            "value_mode": args.value_mode,
+        }
+        assert set(current) == set(ckpt.TRAJECTORY_FIELDS)
+        problems = [
+            f"{k} {meta.get(k)!r} != {v!r}"
+            for k, v in current.items()
+            if meta.get(k) not in (None, v)  # None: pre-upgrade checkpoint
+        ]
+        if meta.get("topology") not in (None, topo.kind):
+            problems.append(f"topology {meta.get('topology')!r} != {topo.kind!r}")
+        if state.alive.shape[0] != topo.num_nodes:
+            problems.append(
+                f"checkpoint has {state.alive.shape[0]} nodes, run has {topo.num_nodes}"
+            )
+        if problems:
+            print("checkpoint mismatch: " + "; ".join(problems), file=sys.stderr)
+            return 2
+
+    # append when resuming: the file keeps covering the whole logical run
+    writer = (
+        JsonlMetricsWriter(args.metrics_out, mode="a" if args.resume else "w")
+        if args.metrics_out else None
+    )
 
     fault_plan = None
     if args.fail_fraction > 0:
@@ -181,30 +228,6 @@ def main(argv=None) -> int:
     if not args.quiet:
         print_start_banner(algo)
 
-    state = None
-    if args.resume:
-        path = args.resume
-        if os.path.isdir(path):
-            path = ckpt.latest(path)
-            if path is None:
-                print(f"no checkpoint found in {args.resume}", file=sys.stderr)
-                return 2
-        state, meta = ckpt.load(path)
-        # a checkpoint from a different experiment would "resume" into a
-        # plausible-but-wrong run — validate before continuing
-        problems = []
-        if meta.get("algorithm") != algo:
-            problems.append(f"algorithm {meta.get('algorithm')!r} != {algo!r}")
-        if meta.get("topology") not in (None, topo.kind):
-            problems.append(f"topology {meta.get('topology')!r} != {topo.kind!r}")
-        if state.alive.shape[0] != topo.num_nodes:
-            problems.append(
-                f"checkpoint has {state.alive.shape[0]} nodes, run has {topo.num_nodes}"
-            )
-        if problems:
-            print("checkpoint mismatch: " + "; ".join(problems), file=sys.stderr)
-            return 2
-
     with maybe_trace(args.profile_dir):
         if args.devices > 1:
             from gossipprotocol_tpu.parallel import run_simulation_sharded
@@ -225,7 +248,7 @@ def main(argv=None) -> int:
     if not args.quiet:
         print(f"rounds: {result.rounds}  converged: {result.converged}  "
               f"nodes: {result.num_nodes}  compile: {result.compile_ms:.1f} ms  "
-              f"devices: {args.devices}  backend: {jax.default_backend()}")
+              f"devices: {args.devices}  backend: {backend_name}")
         err = result.estimate_error
         if err is not None:
             print(f"push-sum max |s/w - mean| = {err:.3e}")

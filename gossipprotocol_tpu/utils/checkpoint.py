@@ -22,6 +22,15 @@ from gossipprotocol_tpu.protocols.state import GossipState, PushSumState
 
 _STATE_TYPES = {"GossipState": GossipState, "PushSumState": PushSumState}
 
+# Every RunConfig field that influences the trajectory. Saved in checkpoint
+# metadata and compared generically on resume — resuming under a different
+# convergence rule (or PRNG seed) would continue on a plausible-looking but
+# different run, which must be an error, not a silent acceptance.
+TRAJECTORY_FIELDS = (
+    "algorithm", "seed", "semantics", "threshold", "eps", "streak_target",
+    "keep_alive", "predicate", "tol", "value_mode",
+)
+
 
 def save(directory: str, state, cfg, topo_kind: str) -> str:
     """Write ``state`` to ``directory/ckpt_round{R}.npz``; returns the path."""
@@ -31,11 +40,9 @@ def save(directory: str, state, cfg, topo_kind: str) -> str:
     meta = {
         "state_type": type(state).__name__,
         "round": int(arrays["round"]),
-        "algorithm": getattr(cfg, "algorithm", None),
-        "seed": getattr(cfg, "seed", None),
-        "semantics": getattr(cfg, "semantics", None),
         "topology": topo_kind,
         "saved_at": time.time(),
+        **{f: getattr(cfg, f, None) for f in TRAJECTORY_FIELDS},
     }
     path = os.path.join(directory, f"ckpt_round{meta['round']:09d}.npz")
     tmp = path + ".tmp.npz"

@@ -18,9 +18,13 @@ from typing import IO, Optional
 class JsonlMetricsWriter:
     """Append one JSON object per metrics record to a file (or stream)."""
 
-    def __init__(self, path_or_stream):
+    def __init__(self, path_or_stream, mode: str = "w"):
         if isinstance(path_or_stream, str):
-            self._fh: IO = open(path_or_stream, "a", buffering=1)
+            # "w" by default: rerunning with the same --metrics-out must not
+            # interleave records from unrelated runs in one JSONL file. A
+            # resume of the same logical run passes mode="a" so the pre-crash
+            # records survive and the file covers the whole trajectory.
+            self._fh: IO = open(path_or_stream, mode, buffering=1)
             self._owns = True
         else:
             self._fh = path_or_stream

@@ -76,3 +76,103 @@ def test_fault_injection_flag(capsys):
         ["64", "full", "gossip", "--fail-fraction", "0.1", "--seed", "3"], capsys
     )
     assert code == 0
+
+
+def test_sharded_devices_flag(capsys):
+    """--devices routes through run_simulation_sharded with --backend
+    forwarded (cli.py); runs on the conftest's 8 simulated CPU devices."""
+    code, out, _ = run_cli([
+        "96", "imp3D", "gossip", "--devices", "8", "--backend", "cpu",
+        "--seed", "0", "--chunk-rounds", "64",
+    ], capsys)
+    assert code == 0
+    assert re.search(r"Convergence Time: \d+\.\d+ ms", out)
+    assert "devices: 8" in out and "backend: cpu" in out
+
+
+def test_sharded_cli_matches_single_chip_rounds(capsys):
+    """Sharding-invariant PRNG: the CLI's --devices path takes the same
+    trajectory (same round count) as the single-chip path."""
+    argv = ["64", "line", "gossip", "--seed", "5", "--chunk-rounds", "64"]
+    code1, out1, _ = run_cli(argv, capsys)
+    code8, out8, _ = run_cli(argv + ["--devices", "8", "--backend", "cpu"], capsys)
+    assert code1 == 0 and code8 == 0
+    r1 = re.search(r"rounds: (\d+)", out1).group(1)
+    r8 = re.search(r"rounds: (\d+)", out8).group(1)
+    assert r1 == r8
+
+
+def test_resume_rejects_seed_and_semantics_mismatch(tmp_path, capsys):
+    ckdir = str(tmp_path / "ck")
+    code, _, _ = run_cli([
+        "32", "full", "gossip", "--seed", "4", "--checkpoint-dir", ckdir,
+        "--checkpoint-every", "1", "--chunk-rounds", "4", "--max-rounds", "8",
+        "--quiet",
+    ], capsys)
+    # resuming with a different seed would continue on a different
+    # round-keyed trajectory — must be rejected, not silently accepted
+    code, _, err = run_cli([
+        "32", "full", "gossip", "--seed", "5", "--resume", ckdir, "--quiet",
+    ], capsys)
+    assert code == 2 and "seed" in err
+    code, _, err = run_cli([
+        "32", "full", "gossip", "--seed", "4", "--semantics", "reference",
+        "--resume", ckdir, "--quiet",
+    ], capsys)
+    assert code == 2 and "semantics" in err
+    # any trajectory-affecting field is validated, not just seed/semantics
+    code, _, err = run_cli([
+        "32", "full", "gossip", "--seed", "4", "--threshold", "5",
+        "--resume", ckdir, "--quiet",
+    ], capsys)
+    assert code == 2 and "threshold" in err
+
+
+def test_rejected_resume_preserves_metrics_file(tmp_path, capsys):
+    """A rejected resume must not truncate the previous run's metrics."""
+    ckdir = str(tmp_path / "ck")
+    mpath = tmp_path / "m.jsonl"
+    code, _, _ = run_cli([
+        "32", "full", "gossip", "--seed", "4", "--checkpoint-dir", ckdir,
+        "--checkpoint-every", "1", "--chunk-rounds", "4", "--max-rounds", "8",
+        "--metrics-out", str(mpath), "--quiet",
+    ], capsys)
+    before = mpath.read_text()
+    assert before
+    code, _, err = run_cli([
+        "32", "full", "gossip", "--seed", "5", "--resume", ckdir,
+        "--metrics-out", str(mpath), "--quiet",
+    ], capsys)
+    assert code == 2
+    assert mpath.read_text() == before
+
+
+def test_resume_appends_metrics_of_same_run(tmp_path, capsys):
+    """A legitimate resume appends so the file covers the whole run."""
+    ckdir = str(tmp_path / "ck")
+    mpath = tmp_path / "m.jsonl"
+    run_cli([
+        "32", "full", "gossip", "--seed", "4", "--checkpoint-dir", ckdir,
+        "--checkpoint-every", "1", "--chunk-rounds", "4", "--max-rounds", "8",
+        "--metrics-out", str(mpath), "--quiet",
+    ], capsys)
+    lines_before = len(mpath.read_text().splitlines())
+    code, _, _ = run_cli([
+        "32", "full", "gossip", "--seed", "4", "--resume", ckdir,
+        "--metrics-out", str(mpath), "--quiet",
+    ], capsys)
+    assert code == 0
+    assert len(mpath.read_text().splitlines()) > lines_before
+
+
+def test_metrics_out_truncates_stale_file(tmp_path, capsys):
+    import json
+
+    path = tmp_path / "metrics.jsonl"
+    path.write_text('{"stale": "record-from-previous-run"}\n')
+    code, _, _ = run_cli(
+        ["32", "full", "gossip", "--metrics-out", str(path), "--quiet"], capsys
+    )
+    assert code == 0
+    records = [json.loads(line) for line in open(path)]
+    assert records and not any("stale" in r for r in records)
