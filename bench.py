@@ -41,6 +41,33 @@ def main():
     )
     ref_1k_ms = 1150.0  # F# baseline, Report.pdf p.1 (red line @1000)
 
+    # --- north-star scale: 10M-node imp3D gossip (BASELINE.md: <60 s on a
+    # v5e-8; measured here on ONE chip). Recorded, not just claimed
+    # (README's 34 s figure). Budget-guarded; skippable for quick local
+    # runs with BENCH_10M=0.
+    aux_10m = {}
+    if os.environ.get("BENCH_10M", "1") != "0":
+        # a 10M failure (OOM, slow host, non-convergence) must not discard
+        # the already-measured headline — report it as an aux error instead
+        try:
+            topo_10m = build_topology("imp3D", 10_000_000, seed=0)
+            res_10m = run_simulation(
+                topo_10m,
+                RunConfig(algorithm="gossip", seed=0, chunk_rounds=4096,
+                          max_rounds=200_000),
+            )
+            assert res_10m.converged, (
+                f"10M run did not converge: {res_10m.rounds}"
+            )
+            aux_10m = {
+                "aux_10M_s": round(res_10m.wall_ms / 1e3, 2),
+                "aux_10M_rounds": res_10m.rounds,
+                "aux_10M_nodes": topo_10m.num_nodes,
+                "aux_10M_vs_60s_target": round(60.0 / (res_10m.wall_ms / 1e3), 2),
+            }
+        except Exception as e:  # noqa: BLE001
+            aux_10m = {"aux_10M_error": f"{type(e).__name__}: {e}"[:200]}
+
     target_s = 48.0  # per-chip share of the 10M<60s v5e-8 north star
     print(json.dumps({
         "metric": "gossip_imp3d_1M_nodes_time_to_convergence",
@@ -53,6 +80,7 @@ def main():
         "backend": jax.default_backend(),
         "aux_1k_ms": round(res_1k.wall_ms, 2),
         "aux_1k_vs_fsharp": round(ref_1k_ms / max(res_1k.wall_ms, 1e-9), 1),
+        **aux_10m,
     }))
 
 

@@ -1,0 +1,138 @@
+"""Attribute per-round wall-clock of the 1M-node gossip chunk (VERDICT #4).
+
+Decomposes one bulk-synchronous gossip round at BENCH scale into its
+kernels and measures while_loop / predicate overhead, printing a
+ms-per-round table.
+
+Measurement notes (both matter on this image):
+  * ``jax.block_until_ready`` does NOT reliably block through the remote
+    "axon" TPU tunnel — every timing here syncs by ``device_get`` of a
+    scalar reduction of the result instead (a data dependency the tunnel
+    cannot skip).
+  * the FIRST execution of a compiled program costs seconds extra
+    (program load + input upload over the tunnel); all timings warm up
+    once and report min-of-repeats.
+
+Usage:  python experiments/profile_round.py [--nodes 1000000] [--rounds 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu import RunConfig, build_topology
+from gossipprotocol_tpu.engine.driver import build_protocol, make_chunk_runner
+from gossipprotocol_tpu.protocols.sampling import device_topology, sample_neighbors
+
+
+def timed(fn, repeats=5):
+    """min-of-repeats seconds; fn must itself sync (device_get a scalar)."""
+    fn()  # warmup: compile + program load + input upload
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def sync(x):
+    """Force full execution: fetch a scalar that depends on every element."""
+    return float(jax.device_get(jnp.sum(jnp.asarray(x, jnp.float32))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1_000_000)
+    ap.add_argument("--rounds", type=int, default=64)
+    ap.add_argument("--profile-dir", type=str, default=None)
+    args = ap.parse_args()
+
+    topo = build_topology("imp3D", args.nodes, seed=0)
+    n = topo.num_nodes
+    # huge threshold: the loop must not converge inside the measured chunk
+    cfg = RunConfig(algorithm="gossip", seed=0, threshold=1_000_000_000)
+    state0, core, done_fn, extra = build_protocol(topo, cfg)
+    nbrs = device_topology(topo)
+    key = jax.random.key(0)
+    R = args.rounds
+    print(f"nodes={n} rounds/loop={R} backend={jax.default_backend()}")
+
+    # mid-run state: everyone has heard, so spreader mask and scatter work
+    # match the steady state the bench spends its time in
+    state0 = state0._replace(counts=jnp.ones_like(state0.counts))
+
+    # (a) the real chunk runner: while_loop with the done predicate in cond
+    runner = make_chunk_runner(core, done_fn, extra)
+    compiled = runner.lower(
+        jax.tree.map(jnp.array, state0), nbrs, key, jnp.int32(0)
+    ).compile()
+
+    def run_chunk():
+        st = jax.tree.map(jnp.array, state0)  # fresh (runner donates)
+        out, stats = compiled(st, nbrs, key, jnp.int32(R))
+        assert int(jax.device_get(stats["round"])) == R
+        return sync(out.counts)
+
+    t_chunk = timed(run_chunk)
+
+    # (b) fori_loop, fixed trip count, no predicate in any cond
+    @jax.jit
+    def chunk_fori(st, nbrs, key):
+        def body(_, s):
+            return core(s, nbrs, key)
+        return jax.lax.fori_loop(0, R, body, st)
+
+    t_fori = timed(lambda: sync(chunk_fori(state0, nbrs, key).counts))
+
+    # (c) kernel decomposition (one round's pieces, jitted separately)
+    @jax.jit
+    def k_sample(st, nbrs, key):
+        k = jax.random.fold_in(key, st.round)
+        return sample_neighbors(nbrs, n, k)[0]
+
+    @jax.jit
+    def k_scatter(v, t):
+        return jax.ops.segment_sum(v, t, num_segments=n)
+
+    @jax.jit
+    def k_predicate(st):
+        return jnp.all(st.converged | ~st.alive)
+
+    @jax.jit
+    def k_round(st, nbrs, key):
+        return core(st, nbrs, key)
+
+    targets = jax.device_get(k_sample(state0, nbrs, key))
+    targets = jnp.asarray(targets)
+    ones = jnp.ones(n, state0.counts.dtype)
+    t_sample = timed(lambda: sync(k_sample(state0, nbrs, key)))
+    t_scatter = timed(lambda: sync(k_scatter(ones, targets)))
+    t_pred = timed(lambda: sync(k_predicate(state0)))
+    t_round1 = timed(lambda: sync(k_round(state0, nbrs, key).counts))
+
+    ms = lambda s: s * 1e3  # noqa: E731
+    print(f"chunk while_loop   : {ms(t_chunk)/R:8.2f} ms/round  ({ms(t_chunk):.1f} ms total)")
+    print(f"chunk fori_loop    : {ms(t_fori)/R:8.2f} ms/round  ({ms(t_fori):.1f} ms total)")
+    print(f"  -> loop/predicate overhead: {ms(t_chunk - t_fori)/R:.2f} ms/round")
+    print(f"single jitted round: {ms(t_round1):8.2f} ms (incl. one dispatch+fetch)")
+    print(f"  sample (threefry+CSR gather): {ms(t_sample):8.2f} ms")
+    print(f"  scatter-add (segment_sum)   : {ms(t_scatter):8.2f} ms")
+    print(f"  predicate (all-reduce)      : {ms(t_pred):8.2f} ms")
+
+    if args.profile_dir:
+        with jax.profiler.trace(args.profile_dir):
+            run_chunk()
+        print(f"trace written to {args.profile_dir}")
+
+
+if __name__ == "__main__":
+    main()

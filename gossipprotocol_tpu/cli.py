@@ -150,57 +150,13 @@ def main(argv=None) -> int:
         print(f"note: {args.topology} rounds {args.num_nodes} up to "
               f"{topo.num_nodes} nodes (Program.fs:239-240 semantics)")
 
-    state = None
-    if args.resume:
-        path = args.resume
-        if os.path.isdir(path):
-            path = ckpt.latest(path)
-            if path is None:
-                print(f"no checkpoint found in {args.resume}", file=sys.stderr)
-                return 2
-        state, meta = ckpt.load(path)
-        # a checkpoint from a different experiment would "resume" into a
-        # plausible-but-wrong run — validate before continuing (and before
-        # anything with side effects, like opening the metrics file)
-        current = {
-            "algorithm": algo,
-            "seed": args.seed,
-            "semantics": args.semantics,
-            "threshold": args.threshold,
-            "eps": args.eps,
-            "streak_target": args.streak,
-            "keep_alive": not args.no_keep_alive,
-            "predicate": args.predicate,
-            "tol": args.tol,
-            "value_mode": args.value_mode,
-        }
-        assert set(current) == set(ckpt.TRAJECTORY_FIELDS)
-        problems = [
-            f"{k} {meta.get(k)!r} != {v!r}"
-            for k, v in current.items()
-            if meta.get(k) not in (None, v)  # None: pre-upgrade checkpoint
-        ]
-        if meta.get("topology") not in (None, topo.kind):
-            problems.append(f"topology {meta.get('topology')!r} != {topo.kind!r}")
-        if state.alive.shape[0] != topo.num_nodes:
-            problems.append(
-                f"checkpoint has {state.alive.shape[0]} nodes, run has {topo.num_nodes}"
-            )
-        if problems:
-            print("checkpoint mismatch: " + "; ".join(problems), file=sys.stderr)
-            return 2
-
-    # append when resuming: the file keeps covering the whole logical run
-    writer = (
-        JsonlMetricsWriter(args.metrics_out, mode="a" if args.resume else "w")
-        if args.metrics_out else None
-    )
-
     fault_plan = None
     if args.fail_fraction > 0:
         fault_plan = faults.random_fault_plan(
             topo.num_nodes, args.fail_fraction, args.fail_round, seed=args.seed
         )
+
+    import dataclasses
 
     import jax.numpy as jnp
 
@@ -219,11 +175,55 @@ def main(argv=None) -> int:
         max_rounds=args.max_rounds,
         chunk_rounds=args.chunk_rounds,
         seed_node=args.seed_node,
-        metrics_callback=writer,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
         fault_plan=fault_plan,
     )
+
+    state = None
+    if args.resume:
+        path = args.resume
+        if os.path.isdir(path):
+            path = ckpt.latest(path)
+            if path is None:
+                print(f"no checkpoint found in {args.resume}", file=sys.stderr)
+                return 2
+        state, meta = ckpt.load(path)
+        # a checkpoint from a different experiment would "resume" into a
+        # plausible-but-wrong run — validate before continuing (and before
+        # anything with side effects, like opening the metrics file).
+        # trajectory_meta(cfg) is the same mapping save() embedded, so the
+        # two sides can never drift.
+        problems = [
+            f"{k} {meta.get(k)!r} != {v!r}"
+            for k, v in ckpt.trajectory_meta(cfg).items()
+            if meta.get(k) not in (None, v)  # None: pre-upgrade checkpoint
+        ]
+        if meta.get("topology") not in (None, topo.kind):
+            problems.append(f"topology {meta.get('topology')!r} != {topo.kind!r}")
+        # content hash catches graphs that differ only via builder knobs
+        # (--avg-degree, --attach) the kind/size checks can't see
+        fp = ckpt.topology_fingerprint(topo)
+        if meta.get("adjacency") not in (None, fp):
+            problems.append(
+                f"adjacency {meta.get('adjacency')!r} != {fp!r} "
+                "(different graph, e.g. --avg-degree/--attach changed)"
+            )
+        if state.alive.shape[0] != topo.num_nodes:
+            problems.append(
+                f"checkpoint has {state.alive.shape[0]} nodes, run has {topo.num_nodes}"
+            )
+        if problems:
+            print("checkpoint mismatch: " + "; ".join(problems), file=sys.stderr)
+            return 2
+
+    # append when resuming: the file keeps covering the whole logical run
+    writer = (
+        JsonlMetricsWriter(args.metrics_out, mode="a" if args.resume else "w")
+        if args.metrics_out else None
+    )
+    if writer:
+        cfg = dataclasses.replace(cfg, metrics_callback=writer)
 
     if not args.quiet:
         print_start_banner(algo)

@@ -1,0 +1,100 @@
+"""Reproduce the reference's push-sum curve *shapes* with the async oracle.
+
+``Report.pdf`` p.2 plots push-sum convergence time vs node count for the
+four topologies. Under the reference's actual semantics that quantity is
+the 2-cover time of a single-token random walk (SURVEY.md §2.4.2) — so its
+*shape* can be reproduced mechanically, hardware-free, by the event-driven
+oracle (``native/asyncsim.cpp``): oracle hop counts stand in for the
+reference's wall-clock (each hop is one actor handler invocation, and the
+reference's wall-clock is hops x per-hop handler latency).
+
+Emits one CSV row per (algorithm, topology, n, seed) plus a median per
+point. Gossip event counts (Report.pdf p.1) are swept too.
+
+    python -m gossipprotocol_tpu.experiments.oracle_curves \
+        --out artifacts/oracle_curves.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import statistics
+import sys
+
+DEFAULT_NODES = "100,250,500,750,1000"
+DEFAULT_TOPOLOGIES = "line,full,3D,imp3D"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="oracle_curves")
+    p.add_argument("--nodes", default=DEFAULT_NODES)
+    p.add_argument("--topologies", default=DEFAULT_TOPOLOGIES)
+    p.add_argument("--seeds", type=int, default=5,
+                   help="oracle runs per point (median reported)")
+    p.add_argument("--out", default="oracle_curves.csv")
+    args = p.parse_args(argv)
+
+    from gossipprotocol_tpu import build_topology, native
+
+    native.build_library()
+    if not native.async_available():
+        print("async oracle unavailable (no g++?)", file=sys.stderr)
+        return 1
+
+    nodes_list = [int(x) for x in args.nodes.split(",")]
+    topologies = args.topologies.split(",")
+
+    rows = []
+    for topo_name in topologies:
+        for n in nodes_list:
+            topo = build_topology(topo_name, n, seed=1)
+            gossip_evs, pushsum_hops = [], []
+            for s in range(args.seeds):
+                gossip_evs.append(
+                    native.async_gossip_events(topo, seed=17 + s, threshold=11)
+                )
+                pushsum_hops.append(
+                    native.async_pushsum_hops(topo, seed=17 + s)
+                )
+            rows.append({
+                "topology": topo_name,
+                "nodes_requested": n,
+                "nodes_actual": topo.num_nodes,
+                "gossip_events_median": int(statistics.median(gossip_evs)),
+                "gossip_events_min": min(gossip_evs),
+                "gossip_events_max": max(gossip_evs),
+                "pushsum_hops_median": int(statistics.median(pushsum_hops)),
+                "pushsum_hops_min": min(pushsum_hops),
+                "pushsum_hops_max": max(pushsum_hops),
+                "seeds": args.seeds,
+            })
+            print(f"{topo_name:6s} n={n:5d} -> gossip ev "
+                  f"{rows[-1]['gossip_events_median']:9d}  push-sum hops "
+                  f"{rows[-1]['pushsum_hops_median']:9d}", file=sys.stderr)
+
+    with open(args.out, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=list(rows[0].keys()))
+        w.writeheader()
+        w.writerows(rows)
+    print(f"wrote {len(rows)} points to {args.out}", file=sys.stderr)
+
+    # Report.pdf p.2 qualitative check at the largest n: full and imp3D
+    # fast, line catastrophic (path 2-cover time is O(n^2))
+    big = max(nodes_list)
+    by = {
+        r["topology"]: r["pushsum_hops_median"]
+        for r in rows if r["nodes_requested"] == big
+    }
+    if {"line", "full", "imp3D"} <= by.keys():
+        ok = by["full"] < by["line"] and by["imp3D"] < by["line"]
+        print(f"shape check @n={big}: full={by['full']} imp3D={by['imp3D']} "
+              f"line={by['line']} -> {'OK' if ok else 'MISMATCH'}",
+              file=sys.stderr)
+        if not ok:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

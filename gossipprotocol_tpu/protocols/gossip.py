@@ -39,7 +39,6 @@ import jax
 import jax.numpy as jnp
 
 from gossipprotocol_tpu.protocols.sampling import (
-    CSRNeighbors,
     device_topology,
     sample_neighbors,
 )
@@ -49,7 +48,7 @@ from gossipprotocol_tpu.topology.base import Topology
 
 def gossip_round_core(
     state: GossipState,
-    nbrs: Optional[CSRNeighbors],
+    nbrs,  # CSRNeighbors | DenseNeighbors | None (implicit full graph)
     base_key: jax.Array,
     *,
     n: int,
@@ -92,7 +91,7 @@ def gossip_round_core(
 @partial(jax.jit, static_argnames=("n", "threshold", "keep_alive"), inline=True)
 def gossip_round(
     state: GossipState,
-    nbrs: Optional[CSRNeighbors],
+    nbrs,  # CSRNeighbors | DenseNeighbors | None (implicit full graph)
     base_key: jax.Array,
     *,
     n: int,

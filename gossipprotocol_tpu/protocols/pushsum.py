@@ -41,7 +41,6 @@ import jax
 import jax.numpy as jnp
 
 from gossipprotocol_tpu.protocols.sampling import (
-    CSRNeighbors,
     device_topology,
     sample_neighbors,
 )
@@ -51,7 +50,7 @@ from gossipprotocol_tpu.topology.base import Topology
 
 def pushsum_round_core(
     state: PushSumState,
-    nbrs: Optional[CSRNeighbors],
+    nbrs,  # CSRNeighbors | DenseNeighbors | None (implicit full graph)
     base_key: jax.Array,
     *,
     n: int,
@@ -149,7 +148,7 @@ def pushsum_round_core(
 )
 def pushsum_round(
     state: PushSumState,
-    nbrs: Optional[CSRNeighbors],
+    nbrs,  # CSRNeighbors | DenseNeighbors | None (implicit full graph)
     base_key: jax.Array,
     *,
     n: int,

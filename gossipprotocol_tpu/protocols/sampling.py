@@ -39,10 +39,71 @@ class CSRNeighbors(NamedTuple):
     indices: jax.Array  # int32[E]
 
 
-def device_topology(topo: Topology) -> Optional[CSRNeighbors]:
-    """Topology → device arrays; None for the implicit complete graph."""
+class DenseNeighbors(NamedTuple):
+    """Padded dense adjacency ``table[i, k]`` = k-th neighbor of row i.
+
+    The fast path for bounded-degree graphs (all four reference topologies;
+    Erdős–Rényi): selecting a random neighbor becomes a one-hot
+    multiply-reduce over the row — pure vectorized elementwise work the TPU
+    streams at HBM bandwidth — instead of a 1-element random gather from
+    the CSR pool, which XLA lowers to a serial-ish scatter/gather loop
+    (measured 1.6 ms vs 22 ms per round at 1M nodes, >13×). Row k ≥
+    degree[i] is padding (zeros), never selected because slots are drawn in
+    [0, degree[i]).
+
+    Unlike :class:`CSRNeighbors` (replicated), the dense table **shards
+    row-wise with the node state**: rows must correspond 1:1 with the rows
+    being sampled (full table single-chip; the device's row block under
+    ``shard_map``) — which also divides its memory footprint by the device
+    count.
+    """
+
+    table: jax.Array    # int32[rows, max_degree]
+    degree: jax.Array   # int32[rows]
+
+
+# Above this max degree the dense table stops paying: one-hot work is
+# O(N·max_deg), and power-law hubs would blow the table up. CSR covers the
+# heavy tail; every reference topology and ER stay far below the cutoff.
+DENSE_MAX_DEGREE = 32
+
+
+def dense_table(topo: Topology) -> "tuple":
+    """Host-side padded [N, max_deg] table + degree from the CSR arrays."""
+    import numpy as np
+
+    deg = topo.degree.astype(np.int32)
+    maxd = int(deg.max()) if deg.size else 1
+    table = np.zeros((topo.num_nodes, max(maxd, 1)), dtype=np.int32)
+    # CSR indices are row-major, so the row-wise mask scatters them into
+    # the right slots in one shot
+    mask = np.arange(table.shape[1])[None, :] < deg[:, None]
+    table[mask] = topo.indices
+    return table, deg
+
+
+def device_topology(topo: Topology, dense: Optional[bool] = None):
+    """Topology → device arrays; None for the implicit complete graph.
+
+    ``dense``: force the dense table (True) or CSR (False); default picks
+    dense when the max degree is bounded (≤ ``DENSE_MAX_DEGREE``) and the
+    ``GOSSIP_TPU_DENSE`` env var doesn't disable it.
+    """
     if topo.implicit_full:
         return None
+    if dense is None:
+        import os
+
+        dense = (
+            os.environ.get("GOSSIP_TPU_DENSE", "1") != "0"
+            and int(topo.degree.max() if topo.degree.size else 0)
+            <= DENSE_MAX_DEGREE
+        )
+    if dense:
+        table, deg = dense_table(topo)
+        return DenseNeighbors(
+            table=jnp.asarray(table), degree=jnp.asarray(deg)
+        )
     return CSRNeighbors(
         starts=jnp.asarray(topo.offsets[:-1]),
         degree=jnp.asarray(topo.degree, dtype=jnp.int32),
@@ -79,7 +140,7 @@ def _per_node_randint(key: jax.Array, gids: jax.Array, maxval: jax.Array) -> jax
 
 
 def sample_neighbors(
-    nbrs: Optional[CSRNeighbors],
+    nbrs,
     n: int,
     key: jax.Array,
     gids: Optional[jax.Array] = None,
@@ -87,8 +148,9 @@ def sample_neighbors(
     """One uniform-random neighbor per node.
 
     Args:
-      nbrs: replicated CSR adjacency, or None for the implicit complete
-        graph on ``n`` nodes.
+      nbrs: adjacency — replicated :class:`CSRNeighbors`, row-aligned
+        :class:`DenseNeighbors`, or None for the implicit complete graph
+        on ``n`` nodes.
       n: global (real, unpadded) node count.
       key: round key; per-node independence comes from folding in gids.
       gids: global node ids to sample for — ``arange(n)`` when omitted
@@ -98,7 +160,29 @@ def sample_neighbors(
     Returns ``(targets int32[L], valid bool[L])``; invalid rows (padding,
     isolated nodes) have their target pinned to a safe in-range id and must
     be masked out by the caller.
+
+    Draws are keyed on *global* ids in every branch, so all backends
+    (CSR / dense / implicit-full) and all layouts (single-chip / sharded)
+    take bitwise-identical trajectories.
     """
+    if isinstance(nbrs, DenseNeighbors):
+        # rows of the table correspond 1:1 with the sampled rows by
+        # contract (full table, or the local shard under shard_map)
+        if gids is None:
+            gids = jnp.arange(n, dtype=jnp.int32)
+            real = None
+        else:
+            real = gids < n
+        deg = nbrs.degree
+        slot = _per_node_randint(key, gids, jnp.maximum(deg, 1))
+        # one-hot select of table[i, slot_i]: elementwise + row-reduce
+        # (exactly one nonzero per row), no gather — the TPU fast path
+        cols = jnp.arange(nbrs.table.shape[1], dtype=slot.dtype)
+        onehot = cols[None, :] == slot[:, None]
+        targets = jnp.sum(jnp.where(onehot, nbrs.table, 0), axis=1)
+        valid = (deg > 0) if real is None else (real & (deg > 0))
+        return jnp.where(valid, targets, 0), valid
+
     if gids is None:
         # single-chip fast path: gids == arange(n), so the row lookups are
         # the arrays themselves — two 1M-row gathers saved per round

@@ -28,21 +28,76 @@ _STATE_TYPES = {"GossipState": GossipState, "PushSumState": PushSumState}
 # different run, which must be an error, not a silent acceptance.
 TRAJECTORY_FIELDS = (
     "algorithm", "seed", "semantics", "threshold", "eps", "streak_target",
-    "keep_alive", "predicate", "tol", "value_mode",
+    "keep_alive", "predicate", "tol", "value_mode", "dtype",
 )
 
 
-def save(directory: str, state, cfg, topo_kind: str) -> str:
-    """Write ``state`` to ``directory/ckpt_round{R}.npz``; returns the path."""
+def trajectory_meta(cfg) -> dict:
+    """JSON-able dict of every trajectory-affecting config field.
+
+    The single source of truth for both sides of resume validation: save()
+    embeds it in checkpoint metadata, the CLI compares it against the
+    resuming run's config — no hand-duplicated field mapping to drift.
+    """
+    meta = {f: getattr(cfg, f, None) for f in TRAJECTORY_FIELDS}
+    if meta.get("dtype") is not None:
+        # jnp.float32 the class is not JSON-able; its dtype name is
+        meta["dtype"] = np.dtype(meta["dtype"]).name
+    return meta
+
+
+def topology_fingerprint(topo) -> str:
+    """Cheap content hash of the adjacency itself.
+
+    Comparing builder *inputs* on resume (kind, node count) misses knobs
+    like --avg-degree/--attach that yield a different graph from the same
+    kind and size; hashing the CSR catches every such mismatch. crc32 runs
+    at GB/s, so this costs well under a second even at 10M nodes.
+    """
+    import zlib
+
+    if topo.implicit_full:
+        return f"full/{topo.num_nodes}"
+    crc = zlib.crc32(topo.indices.tobytes())
+    crc = zlib.crc32(topo.offsets.tobytes(), crc)
+    return f"{topo.num_nodes}/{topo.num_directed_edges}/{crc:08x}"
+
+
+def fetch_host(state):
+    """Host copy of a (possibly multi-process) state pytree.
+
+    Under ``jax.distributed`` the mesh spans processes, so state shards
+    are not all addressable locally and plain ``device_get`` raises; every
+    process then reassembles the full arrays collectively (the DCN
+    analogue of fetching from remote actors).
+    """
+    if all(
+        getattr(x, "is_fully_addressable", True) for x in jax.tree.leaves(state)
+    ):
+        return jax.device_get(state)
+    from jax.experimental import multihost_utils
+
+    return jax.device_get(multihost_utils.process_allgather(state, tiled=True))
+
+
+def save(
+    directory: str, state, cfg, topo_kind: str, adjacency: str | None = None
+) -> str:
+    """Write ``state`` to ``directory/ckpt_round{R}.npz``; returns the path.
+
+    ``adjacency``: :func:`topology_fingerprint` of the run's graph (the
+    driver computes it once per run, not per checkpoint).
+    """
     os.makedirs(directory, exist_ok=True)
-    host = jax.device_get(state)
+    host = fetch_host(state)
     arrays = {f: np.asarray(v) for f, v in zip(type(state)._fields, host)}
     meta = {
         "state_type": type(state).__name__,
         "round": int(arrays["round"]),
         "topology": topo_kind,
+        "adjacency": adjacency,
         "saved_at": time.time(),
-        **{f: getattr(cfg, f, None) for f in TRAJECTORY_FIELDS},
+        **trajectory_meta(cfg),
     }
     path = os.path.join(directory, f"ckpt_round{meta['round']:09d}.npz")
     tmp = path + ".tmp.npz"

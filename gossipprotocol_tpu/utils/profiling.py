@@ -1,16 +1,15 @@
 """Tracing / profiling hooks (SURVEY.md §5.1).
 
 The reference's only instrument is one ``Stopwatch`` around the whole run
-(``Program.fs:35,194,54``). Here: the driver already separates compile time
-from run time and counts rounds; this module adds an optional
+(``Program.fs:35,194,54``) — covered here by the driver's compile-vs-run
+separation and round counts. This module adds the optional
 ``jax.profiler`` trace context so a run can be inspected in
-TensorBoard/Perfetto, plus a tiny stopwatch helper for host-side phases.
+TensorBoard/Perfetto.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
 
 
 @contextlib.contextmanager
@@ -23,21 +22,3 @@ def maybe_trace(trace_dir: str | None):
 
     with jax.profiler.trace(trace_dir):
         yield
-
-
-class Stopwatch:
-    """Reference-style stopwatch (``Program.fs:35``), host-side, ms units."""
-
-    def __init__(self):
-        self._t0 = None
-        self.elapsed_ms = 0.0
-
-    def start(self):
-        self._t0 = time.perf_counter()
-        return self
-
-    def stop(self) -> float:
-        if self._t0 is not None:
-            self.elapsed_ms += (time.perf_counter() - self._t0) * 1e3
-            self._t0 = None
-        return self.elapsed_ms

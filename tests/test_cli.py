@@ -128,6 +128,33 @@ def test_resume_rejects_seed_and_semantics_mismatch(tmp_path, capsys):
     assert code == 2 and "threshold" in err
 
 
+def test_resume_rejects_graph_and_dtype_mismatch(tmp_path, capsys):
+    """Same kind/size but different builder knobs = a different graph; the
+    adjacency fingerprint catches what kind/size checks can't. Likewise a
+    dtype (--x64) flip changes the numeric trajectory."""
+    ckdir = str(tmp_path / "ck")
+    code, _, _ = run_cli([
+        "200", "erdos_renyi", "gossip", "--seed", "4", "--avg-degree", "8",
+        "--checkpoint-dir", ckdir, "--checkpoint-every", "1",
+        "--chunk-rounds", "4", "--max-rounds", "8", "--quiet",
+    ], capsys)
+    code, _, err = run_cli([
+        "200", "erdos_renyi", "gossip", "--seed", "4", "--avg-degree", "3",
+        "--resume", ckdir, "--quiet",
+    ], capsys)
+    assert code == 2 and "adjacency" in err
+    import jax
+
+    try:
+        code, _, err = run_cli([
+            "200", "erdos_renyi", "gossip", "--seed", "4", "--avg-degree", "8",
+            "--x64", "--resume", ckdir, "--quiet",
+        ], capsys)
+        assert code == 2 and "dtype" in err
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
 def test_rejected_resume_preserves_metrics_file(tmp_path, capsys):
     """A rejected resume must not truncate the previous run's metrics."""
     ckdir = str(tmp_path / "ck")

@@ -1,0 +1,107 @@
+"""Neighbor-sampling backends: the dense [N, max_deg] one-hot path and the
+CSR gather path must be interchangeable — same draws, same trajectories —
+so the perf choice (dense for bounded degree, CSR for power-law hubs) can
+never change results."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.protocols.sampling import (
+    CSRNeighbors,
+    DENSE_MAX_DEGREE,
+    DenseNeighbors,
+    device_topology,
+    sample_neighbors,
+)
+
+TOPOS = [
+    ("line", 100, {}),
+    ("3D", 64, {}),
+    ("imp3D", 125, {"seed": 3}),
+    ("erdos_renyi", 200, {"seed": 3, "avg_degree": 6.0}),
+]
+
+
+@pytest.mark.parametrize("name,n,kwargs", TOPOS)
+def test_dense_and_csr_draw_identical_targets(name, n, kwargs):
+    topo = build_topology(name, n, **kwargs)
+    dense = device_topology(topo, dense=True)
+    csr = device_topology(topo, dense=False)
+    assert isinstance(dense, DenseNeighbors)
+    assert isinstance(csr, CSRNeighbors)
+    for r in range(5):
+        key = jax.random.fold_in(jax.random.key(7), r)
+        td, vd = sample_neighbors(dense, topo.num_nodes, key)
+        tc, vc = sample_neighbors(csr, topo.num_nodes, key)
+        np.testing.assert_array_equal(np.asarray(td), np.asarray(tc))
+        np.testing.assert_array_equal(np.asarray(vd), np.asarray(vc))
+
+
+def test_dense_table_rows_match_csr_rows():
+    topo = build_topology("imp3D", 64, seed=1)
+    nbrs = device_topology(topo, dense=True)
+    table = np.asarray(nbrs.table)
+    for i in range(topo.num_nodes):
+        row = topo.indices[topo.offsets[i]:topo.offsets[i + 1]]
+        np.testing.assert_array_equal(table[i, : len(row)], row)
+        assert (table[i, len(row):] == 0).all()
+
+
+def test_backend_selection_auto():
+    # bounded degree -> dense; power-law hubs exceed the cutoff -> CSR
+    assert isinstance(
+        device_topology(build_topology("imp3D", 125, seed=1)), DenseNeighbors
+    )
+    pl = build_topology("power_law", 2000, m=4, seed=1)
+    assert pl.degree.max() > DENSE_MAX_DEGREE
+    assert isinstance(device_topology(pl), CSRNeighbors)
+    # implicit full graph stays implicit
+    assert device_topology(build_topology("full", 100)) is None
+
+
+def test_backend_invariant_trajectories(monkeypatch):
+    """Full simulation: flipping the sampling backend changes nothing."""
+    topo = build_topology("imp3D", 125, seed=2)
+    cfg = RunConfig(algorithm="gossip", seed=9, chunk_rounds=64)
+    res_dense = run_simulation(topo, cfg)
+    monkeypatch.setenv("GOSSIP_TPU_DENSE", "0")
+    res_csr = run_simulation(topo, cfg)
+    assert res_dense.rounds == res_csr.rounds
+    np.testing.assert_array_equal(
+        np.asarray(res_dense.final_state.counts),
+        np.asarray(res_csr.final_state.counts),
+    )
+
+
+def test_backend_invariant_pushsum(monkeypatch):
+    topo = build_topology("erdos_renyi", 128, seed=2, avg_degree=8.0)
+    cfg = RunConfig(algorithm="push-sum", seed=9, chunk_rounds=64)
+    res_dense = run_simulation(topo, cfg)
+    monkeypatch.setenv("GOSSIP_TPU_DENSE", "0")
+    res_csr = run_simulation(topo, cfg)
+    assert res_dense.rounds == res_csr.rounds
+    np.testing.assert_array_equal(
+        np.asarray(res_dense.final_state.s), np.asarray(res_csr.final_state.s)
+    )
+
+
+def test_sharded_dense_matches_single_chip(cpu_devices):
+    """The row-sharded dense table under shard_map takes the same
+    trajectory as single-chip (sharding-invariant draws, row-aligned
+    shards incl. padding: 125 -> 128 rows on 8 devices)."""
+    from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+    topo = build_topology("imp3D", 125, seed=2)
+    cfg = RunConfig(algorithm="gossip", seed=9, chunk_rounds=64)
+    single = run_simulation(topo, cfg)
+    sharded = run_simulation_sharded(
+        topo, cfg, mesh=make_mesh(devices=cpu_devices[:8])
+    )
+    assert sharded.rounds == single.rounds
+    np.testing.assert_array_equal(
+        np.asarray(sharded.final_state.counts),
+        np.asarray(single.final_state.counts),
+    )
