@@ -1,0 +1,147 @@
+"""Render the curve artifacts as figures mirroring Report.pdf p.1-2.
+
+Two PNGs into artifacts/:
+
+  * ``curves_plot.png`` — TPU wall-clock to convergence vs node count
+    (from ``curves_tpu_v5e1.csv``), one panel per algorithm;
+  * ``oracle_plot.png`` — async-oracle event/hop counts vs node count
+    (from ``oracle_curves.csv``): the reference's *shapes* (its wall-clock
+    is hops x per-hop latency), reproduced mechanically.
+
+Styling follows the repo-neutral dataviz method: categorical slots in
+fixed order, thin 2px lines, recessive grid, direct end-labels (which
+also satisfy the light-surface contrast relief rule for the yellow slot),
+one y-axis per panel.
+
+    python experiments/plot_curves.py
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from collections import defaultdict
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(REPO, "artifacts")
+
+# categorical slots, fixed order (validated reference palette, light mode)
+SLOT = {"line": "#2a78d6", "full": "#eb6834", "3D": "#1baf7a", "imp3D": "#eda100"}
+SURFACE = "#fcfcfb"
+INK = "#0b0b0b"
+MUTED = "#898781"
+GRID = "#e1e0d9"
+BASELINE = "#c3c2b7"
+TOPO_ORDER = ["line", "full", "3D", "imp3D"]
+
+
+def _style_axis(ax, title, ylabel):
+    ax.set_facecolor(SURFACE)
+    ax.set_title(title, color=INK, fontsize=11, loc="left")
+    ax.set_xlabel("nodes", color=MUTED, fontsize=9)
+    ax.set_ylabel(ylabel, color=MUTED, fontsize=9)
+    ax.grid(True, color=GRID, linewidth=0.6)
+    for spine in ("top", "right"):
+        ax.spines[spine].set_visible(False)
+    for spine in ("left", "bottom"):
+        ax.spines[spine].set_color(BASELINE)
+    ax.tick_params(colors=MUTED, labelsize=8)
+
+
+def _plot_series(ax, series, logy=False):
+    import math
+
+    ends = []
+    for topo in TOPO_ORDER:
+        if topo not in series:
+            continue
+        xs, ys = zip(*sorted(series[topo]))
+        ax.plot(xs, ys, color=SLOT[topo], linewidth=2,
+                marker="o", markersize=4, label=topo)
+        ends.append((topo, xs[-1], ys[-1]))
+    if logy:
+        ax.set_yscale("log")
+
+    # direct end-labels (identity never color-alone), pushed apart when
+    # final points land too close to read
+    def pos(y):
+        return math.log10(y) if logy else y
+
+    lo = min(pos(y) for _, _, y in ends)
+    hi = max(pos(y) for _, _, y in ends)
+    min_sep = max((hi - lo), 1e-9) * 0.07 or 1.0
+    placed = []
+    for topo, x, y in sorted(ends, key=lambda e: pos(e[2])):
+        p = pos(y)
+        if placed and p - placed[-1] < min_sep:
+            p = placed[-1] + min_sep
+        placed.append(p)
+        ax.annotate(f" {topo}", (x, 10 ** p if logy else p),
+                    color=SLOT[topo], fontsize=8, va="center")
+    ax.legend(frameon=False, fontsize=8, labelcolor=INK)
+
+
+def load_rows(path):
+    with open(path) as fh:
+        return list(csv.DictReader(fh))
+
+
+def main():
+    # --- TPU wall-clock curves -------------------------------------------
+    rows = load_rows(os.path.join(ART, "curves_tpu_v5e1.csv"))
+    fig, axes = plt.subplots(1, 2, figsize=(10, 4))
+    fig.patch.set_facecolor(SURFACE)
+    for ax, algo, ref_note in (
+        (axes[0], "gossip", "Report.pdf p.1: 250-3700 ms"),
+        (axes[1], "push-sum", "Report.pdf p.2: 500-8400 ms"),
+    ):
+        series = defaultdict(list)
+        for r in rows:
+            if r["algorithm"] == algo:
+                series[r["topology"]].append(
+                    (int(r["nodes_requested"]), float(r["wall_ms"]))
+                )
+        _plot_series(ax, series)
+        _style_axis(ax, f"{algo} — TPU v5e (1 chip)", "wall-clock ms")
+        ax.set_ylim(bottom=0)
+        ax.annotate(f"F# reference range: {ref_note.split(': ')[1]}",
+                    xy=(0.02, 0.02), xycoords="axes fraction",
+                    color=MUTED, fontsize=8)
+    fig.suptitle("Time to convergence vs node count (dispatch-bound flat "
+                 "~200 ms; reference is 250-8400 ms)", color=INK, fontsize=10)
+    fig.tight_layout()
+    out1 = os.path.join(ART, "curves_plot.png")
+    fig.savefig(out1, dpi=150, facecolor=SURFACE)
+
+    # --- oracle shape curves ---------------------------------------------
+    rows = load_rows(os.path.join(ART, "oracle_curves.csv"))
+    fig, axes = plt.subplots(1, 2, figsize=(10, 4))
+    fig.patch.set_facecolor(SURFACE)
+    for ax, col, title in (
+        (axes[0], "gossip_events_median", "gossip — oracle event count"),
+        (axes[1], "pushsum_hops_median", "push-sum — oracle walk hops"),
+    ):
+        series = defaultdict(list)
+        for r in rows:
+            series[r["topology"]].append(
+                (int(r["nodes_requested"]), int(r[col]))
+            )
+        _plot_series(ax, series, logy=True)
+        _style_axis(ax, title, "events (log)")
+    fig.suptitle("Reference actor-semantics shapes via the async oracle "
+                 "(full < imp3D ≤ 3D ≪ line — matches Report.pdf)",
+                 color=INK, fontsize=10)
+    fig.tight_layout()
+    out2 = os.path.join(ART, "oracle_plot.png")
+    fig.savefig(out2, dpi=150, facecolor=SURFACE)
+    print(out1)
+    print(out2)
+
+
+if __name__ == "__main__":
+    main()

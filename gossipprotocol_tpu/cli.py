@@ -92,6 +92,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="round at which the failures strike")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="emit a jax.profiler trace here")
+    p.add_argument("--check", action="store_true",
+                   help="build and validate the topology, print its shape "
+                        "summary, and exit without simulating")
     p.add_argument("--quiet", action="store_true",
                    help="suppress everything except the convergence metric")
     return p
@@ -149,6 +152,19 @@ def main(argv=None) -> int:
     if not args.quiet and topo.num_nodes != args.num_nodes:
         print(f"note: {args.topology} rounds {args.num_nodes} up to "
               f"{topo.num_nodes} nodes (Program.fs:239-240 semantics)")
+
+    if args.check:
+        try:
+            topo.validate()
+        except AssertionError as e:
+            print(f"topology invalid: {e}", file=sys.stderr)
+            return 2
+        deg = topo.degree
+        print(f"topology ok: kind={topo.kind} nodes={topo.num_nodes} "
+              f"directed_edges={topo.num_directed_edges} "
+              f"degree min/mean/max = {int(deg.min())}/"
+              f"{float(deg.mean()):.2f}/{int(deg.max())}")
+        return 0
 
     fault_plan = None
     if args.fail_fraction > 0:

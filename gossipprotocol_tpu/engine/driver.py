@@ -392,18 +392,28 @@ def run_simulation(
     def step(s, round_limit):
         return compiled(s, nbrs, base_key, jnp.int32(round_limit))
 
-    # Warm execution with round_limit=-1: the while_loop body never runs
-    # (s.round < -1 is false at any round, including on resume), but the
-    # program is loaded onto the chip and the state/topology buffers are
-    # uploaded. On a tunneled TPU this first execution costs seconds —
-    # setup cost, not algorithm time: the reference's stopwatch likewise
-    # starts after actors are spawned and neighbor lists delivered
-    # (timer.Start() follows the wiring, Program.fs:194).
-    state, warm_stats = step(state, -1)
-    jax.device_get(warm_stats)  # block until the program has really run
+    state = warm_start(step, state)
     compile_ms = (time.perf_counter() - t0) * 1e3
 
     return _drive(topo, cfg, state, step, done_fn, compile_ms)
+
+
+def warm_start(step, state):
+    """Execute the compiled step once with round_limit=-1 and return the
+    warmed state.
+
+    The while_loop body never runs (``s.round < -1`` is false at any
+    round, including on resume), but the program is loaded onto the chip
+    and the state/topology buffers are uploaded. On a tunneled TPU this
+    first execution costs seconds — setup cost, not algorithm time: the
+    reference's stopwatch likewise starts only after actors are spawned
+    and neighbor lists delivered (``timer.Start()``, ``Program.fs:194``).
+    The stats fetch is the sync point (``block_until_ready`` does not
+    reliably block through the axon tunnel).
+    """
+    state, warm_stats = step(state, -1)
+    jax.device_get(warm_stats)
+    return state
 
 
 def resume_simulation(topo: Topology, cfg: RunConfig, state) -> RunResult:

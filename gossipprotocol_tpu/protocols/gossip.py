@@ -33,8 +33,6 @@ Divergences from the reference, all documented quirk-vs-capability calls
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 

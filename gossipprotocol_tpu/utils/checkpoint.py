@@ -89,6 +89,9 @@ def save(
     driver computes it once per run, not per checkpoint).
     """
     os.makedirs(directory, exist_ok=True)
+    # fetch_host is a collective under jax.distributed — every process must
+    # call it — but only one process may publish: concurrent writers would
+    # race on the tmp path and could publish a truncated/interleaved zip
     host = fetch_host(state)
     arrays = {f: np.asarray(v) for f, v in zip(type(state)._fields, host)}
     meta = {
@@ -100,6 +103,8 @@ def save(
         **trajectory_meta(cfg),
     }
     path = os.path.join(directory, f"ckpt_round{meta['round']:09d}.npz")
+    if jax.process_index() != 0:
+        return path
     tmp = path + ".tmp.npz"
     np.savez_compressed(tmp, __meta__=json.dumps(meta), **arrays)
     os.replace(tmp, path)

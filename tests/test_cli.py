@@ -71,6 +71,13 @@ def test_metrics_out_jsonl(tmp_path, capsys):
     assert records and all("converged" in r for r in records)
 
 
+def test_check_flag_validates_without_running(capsys):
+    code, out, _ = run_cli(["125", "imp3D", "gossip", "--check"], capsys)
+    assert code == 0
+    assert "topology ok" in out and "nodes=125" in out
+    assert "Convergence Time" not in out
+
+
 def test_fault_injection_flag(capsys):
     code, out, _ = run_cli(
         ["64", "full", "gossip", "--fail-fraction", "0.1", "--seed", "3"], capsys

@@ -88,6 +88,26 @@ def test_backend_invariant_pushsum(monkeypatch):
     )
 
 
+def test_sharded_csr_matches_single_chip(cpu_devices):
+    """Power-law exceeds DENSE_MAX_DEGREE, so this exercises the
+    *replicated CSR* path under shard_map — which dense's promotion to
+    default would otherwise leave untested."""
+    from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+    topo = build_topology("power_law", 256, m=4, seed=5)
+    assert isinstance(device_topology(topo), CSRNeighbors)
+    cfg = RunConfig(algorithm="gossip", seed=9, chunk_rounds=64)
+    single = run_simulation(topo, cfg)
+    sharded = run_simulation_sharded(
+        topo, cfg, mesh=make_mesh(devices=cpu_devices[:8])
+    )
+    assert sharded.rounds == single.rounds
+    np.testing.assert_array_equal(
+        np.asarray(sharded.final_state.counts),
+        np.asarray(single.final_state.counts),
+    )
+
+
 def test_sharded_dense_matches_single_chip(cpu_devices):
     """The row-sharded dense table under shard_map takes the same
     trajectory as single-chip (sharding-invariant draws, row-aligned
