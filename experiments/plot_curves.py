@@ -64,6 +64,8 @@ def _plot_series(ax, series, logy=False):
         ax.plot(xs, ys, color=SLOT[topo], linewidth=2,
                 marker="o", markersize=4, label=topo)
         ends.append((topo, xs[-1], ys[-1]))
+    if not ends:
+        return  # empty panel (restricted CSV) — render blank, don't crash
     if logy:
         ax.set_yscale("log")
 

@@ -155,16 +155,33 @@ def pick_seed_node(num_nodes: int, seed: int) -> int:
 
 
 def initial_alive(topo: Topology) -> Optional[jax.Array]:
-    """Healthy-at-birth mask: isolated (degree-0) nodes — statistically
-    expected in large Erdős–Rényi graphs — can never hear anything, so
-    they are excluded from the supervisor's predicate up front (same
-    mechanism as fault-injected nodes). None = everyone healthy."""
-    if topo.implicit_full:
+    """Healthy-at-birth mask: only the largest connected component.
+
+    Sparse random graphs are born with isolated nodes *and* small
+    components (ER(8)@10M: ~3350 degree-0 nodes and a handful of isolated
+    pairs/triples). Neither can ever agree with the majority — the rumor
+    cannot reach them, and push-sum averages per component — so they are
+    excluded from the supervisor's predicate up front, the same mechanism
+    as fault-injected nodes (majority-partition semantics,
+    :func:`gossipprotocol_tpu.utils.faults.kill_disconnected`).
+    None = everyone healthy."""
+    if topo.implicit_full or topo.kind in CONNECTED_BY_CONSTRUCTION:
         return None
-    deg = topo.degree
-    if (deg > 0).all():
+    from gossipprotocol_tpu.utils.faults import kill_disconnected
+
+    alive = kill_disconnected(topo, np.ones(topo.num_nodes, dtype=bool))
+    if alive.all():
         return None
-    return jnp.asarray(deg > 0)
+    return jnp.asarray(alive)
+
+
+# Builders whose output is connected for every input, so the birth-time
+# component check (a full scipy connected-components pass — seconds and
+# gigabytes of transient host RAM at 10M nodes) can be skipped: the path,
+# the lattices (imp3D only adds edges), and preferential attachment (each
+# new node attaches to an existing one). Erdős–Rényi and user-supplied
+# edge lists get the real check.
+CONNECTED_BY_CONSTRUCTION = frozenset({"line", "3D", "imp3D", "power_law"})
 
 
 def build_protocol(topo: Topology, cfg: RunConfig, num_rows: Optional[int] = None):
@@ -321,9 +338,25 @@ def _drive(
         # fault injection (SURVEY.md §5.3): strike everything due; the
         # round_limit below guarantees we stop exactly at the next
         # scheduled fault so none can be skipped
-        for r in [r for r in fault_plan if r <= cur_round]:
-            ids = np.asarray(fault_plan.pop(r), dtype=np.int64)
-            state = state._replace(alive=state.alive.at[ids].set(False))
+        due = [r for r in fault_plan if r <= cur_round]
+        if due:
+            from gossipprotocol_tpu.utils import faults as faults_mod
+
+            alive_host = np.array(ckpt_mod.fetch_host(state.alive))  # writable copy
+            for r in due:
+                ids = np.asarray(fault_plan.pop(r), dtype=np.int64)
+                alive_host[ids] = False
+            # unreachable-from-the-majority == failed: stranded survivors
+            # and fault-split minority components would hang the
+            # predicate forever (majority-partition semantics)
+            alive_host[: topo.num_nodes] = faults_mod.kill_disconnected(
+                topo, alive_host[: topo.num_nodes]
+            )
+            # placed back with the original sharding — the compiled step
+            # expects its input layout unchanged
+            state = state._replace(
+                alive=jax.device_put(alive_host, state.alive.sharding)
+            )
 
         next_fault = min(fault_plan, default=cfg.max_rounds)
         round_limit = min(cur_round + chunk_rounds, cfg.max_rounds, next_fault)

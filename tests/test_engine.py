@@ -86,6 +86,77 @@ def test_isolated_nodes_excluded_from_predicate():
     assert not bool(np.asarray(res.final_state.alive)[4])
 
 
+def test_fault_stranded_survivors_treated_as_failed():
+    """A fault that cuts a survivor off from every alive neighbor strands
+    it — frozen state, can never receive — so the driver marks it failed
+    too (unreachable == failed), instead of letting the predicate wait on
+    it forever. Cascades: killing 2 on the path 0-1-2-3-4 strands nothing,
+    but killing 1 strands 0."""
+    from gossipprotocol_tpu.topology import csr_from_edges
+
+    topo = csr_from_edges(
+        5, np.array([[0, 1], [1, 2], [2, 3], [3, 4]]), kind="path"
+    )
+    plan = {4: np.array([1])}
+    cfg = RunConfig(
+        algorithm="push-sum", seed=0, predicate="global", tol=1e-4,
+        fault_plan=plan, chunk_rounds=16, max_rounds=5_000,
+    )
+    res = run_simulation(topo, cfg)
+    assert res.converged, "stranded node 0 must not hang the predicate"
+    alive = np.asarray(res.final_state.alive)
+    assert not alive[1]  # killed by the plan
+    assert not alive[0]  # stranded -> treated as failed
+    assert alive[[2, 3, 4]].all()
+
+
+def test_kill_disconnected_majority_partition():
+    """Only the largest alive component survives; ties below size 2 kill
+    everyone (a single node cannot run a message-passing protocol)."""
+    from gossipprotocol_tpu.topology import csr_from_edges
+    from gossipprotocol_tpu.utils.faults import kill_disconnected
+
+    # 0-1-2 with 1 dead: two singletons -> nobody survives
+    topo = csr_from_edges(3, np.array([[0, 1], [1, 2]]), kind="path")
+    assert not kill_disconnected(topo, np.array([True, False, True])).any()
+    # 0-1  2-3-4: majority component {2,3,4} survives, pair {0,1} dies
+    topo = csr_from_edges(
+        5, np.array([[0, 1], [2, 3], [3, 4]]), kind="two-comps"
+    )
+    out = kill_disconnected(topo, np.ones(5, bool))
+    assert list(out) == [False, False, True, True, True]
+    # full topology: any two alive nodes are connected
+    full = build_topology("full", 4)
+    out = kill_disconnected(full, np.array([True, False, False, True]))
+    assert list(out) == [True, False, False, True]
+    assert not kill_disconnected(
+        full, np.array([True, False, False, False])
+    ).any()
+
+
+def test_minority_components_excluded_at_birth():
+    """A graph born with a small side component (sparse ER reality) must
+    not hang the sound predicate: the minority pair is excluded up front
+    and the majority converges to ITS mean."""
+    from gossipprotocol_tpu.topology import csr_from_edges
+
+    # majority: 0..3 cycle; minority: 4-5 pair
+    topo = csr_from_edges(
+        6,
+        np.array([[0, 1], [1, 2], [2, 3], [3, 0], [4, 5]]),
+        kind="er-ish",
+    )
+    cfg = RunConfig(
+        algorithm="push-sum", seed=0, predicate="global", tol=1e-4,
+        chunk_rounds=32, max_rounds=2_000,
+    )
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    alive = np.asarray(res.final_state.alive)
+    assert list(alive) == [True, True, True, True, False, False]
+    assert res.estimate_error is not None and res.estimate_error <= 2e-4
+
+
 def test_metrics_callback_stream():
     topo = build_topology("full", 32)
     records = []
