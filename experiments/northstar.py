@@ -137,12 +137,33 @@ def main():
     t0 = time.perf_counter()
     topo_pl = build_topology("power_law", n, m=4, seed=0)
     pl_build_s = time.perf_counter() - t0
+    # chunk_rounds stays modest: one 10M-row chunk of ~250 rounds is a
+    # >2-minute single device program, which trips the remote-execution
+    # watchdog (observed: TPU worker crash)
     res_pl = run_simulation(topo_pl, RunConfig(
         algorithm="push-sum", seed=0, predicate="global", tol=1e-4,
-        chunk_rounds=250, max_rounds=1_000,
+        chunk_rounds=64, max_rounds=1_000,
     ))
     pl_state = res_pl.final_state
     pl_mass = float(np.asarray(pl_state.w, np.float64).sum())
+    # float32 mass drift is REAL on hub graphs (SURVEY.md §7 hard part d):
+    # once the mega-hub's w reaches ~2^23, each incoming half-weight is at
+    # ulp scale and the scatter-add leaks — measured ~0.7% over 1k rounds.
+    # Quantified here; act 5b shows float64 removes it.
+    pl_drift = abs(pl_mass - topo_pl.num_nodes) / topo_pl.num_nodes
+
+    print("[northstar] act 5b: power-law float64 numerics ...", flush=True)
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)  # last act; nothing f32 follows
+    # tiny chunks: TPU f64 is software-emulated (~10-30x slower), and a
+    # multi-minute on-device chunk trips the remote watchdog (observed)
+    res_pl64 = run_simulation(topo_pl, RunConfig(
+        algorithm="push-sum", seed=0, predicate="global", tol=1e-4,
+        chunk_rounds=4, max_rounds=16, dtype=jnp.float64,
+    ))
+    pl64_mass = float(np.asarray(res_pl64.final_state.w, np.float64).sum())
+    pl64_drift = abs(pl64_mass - topo_pl.num_nodes) / topo_pl.num_nodes
 
     summary = {
         "config": {
@@ -173,10 +194,14 @@ def main():
             "converged": res_pl.converged,
             "wall_s": round(res_pl.wall_ms / 1e3, 2),
             "estimate_error": res_pl.estimate_error,
-            "mass_conserved_w": pl_mass,
+            "sum_w_final_f32": pl_mass,
+            "mass_drift_f32": pl_drift,
+            "mass_drift_f64_16rounds": pl64_drift,
             "note": "bounded run: hub-leaf receipt rate makes global-tol "
                     "convergence O(max_degree) rounds — capability demo, "
-                    "error-at-budget reported",
+                    "error-at-budget reported. f32 scatter-add into the "
+                    "degree-1M hub leaks w at ulp scale (quantified); "
+                    "--x64 eliminates it (also quantified)",
         },
         "backend": jax.default_backend(),
     }
@@ -186,13 +211,10 @@ def main():
     print(json.dumps(summary, indent=2))
     assert s_match and rounds_match, "resume transparency violated"
     assert res2.converged and shard_ok
-    # power-law act: scale capability + exact mass conservation (Sum w ==
-    # alive node count: every alive node started with w=1 and dead mass is
-    # stranded, SURVEY.md §7 hard part d)
-    alive_w = float(
-        np.asarray(pl_state.w, np.float64)[np.asarray(pl_state.alive)].sum()
-    )
-    assert abs(alive_w - int(np.asarray(pl_state.alive).sum())) < 1.0, alive_w
+    # power-law numerics: f32 hub leakage stays within its measured band;
+    # f64 conserves mass to float64 rounding (SURVEY.md §7 hard part d)
+    assert pl_drift < 0.02, f"f32 hub drift grew: {pl_drift}"
+    assert pl64_drift < 1e-9, f"f64 should conserve mass: {pl64_drift}"
 
 
 if __name__ == "__main__":

@@ -27,6 +27,18 @@ def test_reference_combos_gossip(topology, capsys):
     assert re.search(r"Convergence Time: \d+\.\d+ ms", out)
 
 
+@pytest.mark.parametrize("topology", ["line", "full", "3D", "imp3D"])
+def test_reference_combos_pushsum(topology, capsys):
+    """With gossip above, completes the reference's full 4x2 CLI grid
+    (SURVEY.md §4.3)."""
+    code, out, _ = run_cli([
+        "27", topology, "push-sum", "--seed", "1", "--chunk-rounds", "256",
+    ], capsys)
+    assert code == 0
+    assert "Push Sum Starts" in out
+    assert re.search(r"Convergence Time: \d+\.\d+ ms", out)
+
+
 def test_pushsum_cli_banner_and_metric(capsys):
     code, out, _ = run_cli(["64", "full", "push-sum", "--seed", "1"], capsys)
     assert code == 0
