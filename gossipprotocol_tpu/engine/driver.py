@@ -103,11 +103,18 @@ class RunConfig:
 
     def resolve_chunk_rounds(self, num_nodes: int) -> int:
         """Auto chunk size: target ~30 s of on-device work per chunk at an
-        observed ~100 ns/node/round, clamped to [32, 4096]."""
+        observed ~100 ns/node/round, clamped to [4, 4096].
+
+        float64 divides the budget by 16: TPU f64 is software-emulated
+        (~10-30x slower), and a multi-minute on-device chunk trips the
+        remote-execution watchdog (observed as a TPU worker crash).
+        """
         if self.chunk_rounds is not None:
             return self.chunk_rounds
         est = int(3e8 / max(num_nodes, 1))
-        return max(32, min(4096, est))
+        if jnp.dtype(self.dtype) == jnp.float64:
+            est //= 16
+        return max(4, min(4096, est))
 
 
 @dataclasses.dataclass

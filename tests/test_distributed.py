@@ -41,13 +41,22 @@ assert len(jax.devices()) == 4, jax.devices()
 topo = build_topology("imp3D", 27, seed=1)
 res = run_simulation_sharded(
     topo,
-    RunConfig(algorithm="gossip", seed=0, chunk_rounds=64),
+    RunConfig(algorithm="gossip", seed=0, chunk_rounds=64,
+              checkpoint_every=1, checkpoint_dir=sys.argv[4]),
     mesh=make_mesh(),
 )
 import numpy as np
 counts = np.asarray(res.final_state.counts)
+# checkpointing under jax.distributed: the fetch is collective (all
+# processes), the write is process-0-only — both must agree it happened
+from gossipprotocol_tpu.utils import checkpoint as ckpt
+latest = ckpt.latest(sys.argv[4])
+assert latest is not None, "no checkpoint written"
+state, meta = ckpt.load(latest)
+assert state.counts.shape[0] == res.num_nodes
 print(f"FINGERPRINT rounds={res.rounds} converged={res.converged} "
-      f"sum={int(counts.sum())} n={res.num_nodes}", flush=True)
+      f"sum={int(counts.sum())} n={res.num_nodes} "
+      f"ckpt_round={meta['round']}", flush=True)
 """
 
 
@@ -58,12 +67,13 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_mesh_matches_single_chip():
+def test_two_process_mesh_matches_single_chip(tmp_path):
     port = _free_port()
     env = {**os.environ, "PYTHONPATH": ""}
+    ckdir = str(tmp_path / "ck")
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(i), str(port), REPO],
+            [sys.executable, "-c", _WORKER, str(i), str(port), REPO, ckdir],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env,
         )
@@ -98,6 +108,8 @@ def test_two_process_mesh_matches_single_chip():
     topo = build_topology("imp3D", 27, seed=1)
     res = run_simulation(topo, RunConfig(algorithm="gossip", seed=0, chunk_rounds=64))
     counts = np.asarray(res.final_state.counts)
+    # single chunk -> the one checkpoint lands at the final round
     expected = (f"FINGERPRINT rounds={res.rounds} converged={res.converged} "
-                f"sum={int(counts.sum())} n={res.num_nodes}")
+                f"sum={int(counts.sum())} n={res.num_nodes} "
+                f"ckpt_round={res.rounds}")
     assert fps[0] == expected

@@ -157,6 +157,18 @@ def test_minority_components_excluded_at_birth():
     assert res.estimate_error is not None and res.estimate_error <= 2e-4
 
 
+def test_auto_chunk_shrinks_for_float64():
+    """TPU f64 is emulated ~10-30x slower; the auto chunk must shrink so
+    one on-device chunk stays under remote-execution watchdogs."""
+    import jax.numpy as jnp
+
+    f32 = RunConfig(algorithm="push-sum")
+    f64 = RunConfig(algorithm="push-sum", dtype=jnp.float64)
+    n = 10_000_000
+    assert f64.resolve_chunk_rounds(n) * 16 <= f32.resolve_chunk_rounds(n) + 64
+    assert f64.resolve_chunk_rounds(n) >= 4
+
+
 def test_metrics_callback_stream():
     topo = build_topology("full", 32)
     records = []
