@@ -60,7 +60,7 @@ def main():
     n = topo.num_nodes
     # huge threshold: the loop must not converge inside the measured chunk
     cfg = RunConfig(algorithm="gossip", seed=0, threshold=1_000_000_000)
-    state0, core, done_fn, extra = build_protocol(topo, cfg)
+    state0, core, done_fn, extra, _ = build_protocol(topo, cfg)
     nbrs = device_topology(topo)
     key = jax.random.key(0)
     R = args.rounds

@@ -191,17 +191,42 @@ def initial_alive(topo: Topology) -> Optional[jax.Array]:
 CONNECTED_BY_CONSTRUCTION = frozenset({"line", "3D", "imp3D", "power_law"})
 
 
-def build_protocol(topo: Topology, cfg: RunConfig, num_rows: Optional[int] = None):
-    """(init_state, round_core(state, nbrs, key, ...), done_fn, extra_stats).
+def build_protocol(
+    topo: Topology,
+    cfg: RunConfig,
+    num_rows: Optional[int] = None,
+    allow_all_alive: bool = True,
+):
+    """(init_state, round_core(state, nbrs, key, ...), done_fn, extra_stats,
+    (all_alive, targets_alive)).
+
+    The returned flag pair is the single source of truth for the liveness
+    fast paths — the sharded engine reuses it rather than re-deriving
+    eligibility with its own formula.
 
     ``num_rows`` > num_nodes pads the state with phantom rows (dead and
     converged — invisible to the protocol and the predicate) for sharding.
     ``extra_stats`` (or None) adds protocol-specific scalars to the chunk
     stats — gossip reports its spreader count for stall detection.
+
+    When no node can ever die — no fault plan, no birth exclusions, no
+    padding rows — the round compiles with the aliveness masks removed
+    (``all_alive``), dropping a full-length random gather from push-sum
+    (~29 % of the round at 10M nodes). ``allow_all_alive=False`` forces
+    the general path: required when resuming a checkpoint that already
+    carries dead nodes.
     """
     ref = cfg.semantics == "reference"
     n = topo.num_nodes
     rows = num_rows or n
+    alive0 = initial_alive(topo)
+    all_alive = (
+        allow_all_alive and not cfg.fault_plan and alive0 is None and rows == n
+    )
+    # birth exclusions are whole components, so an alive node's neighbors
+    # are alive: the target-liveness gather can go as long as no fault
+    # plan (or resumed dead set) can make the dead set component-open
+    targets_alive = allow_all_alive and not cfg.fault_plan
     if cfg.algorithm == "gossip":
         seed_node = (
             pick_seed_node(n, cfg.seed) if cfg.seed_node is None else cfg.seed_node
@@ -211,7 +236,8 @@ def build_protocol(topo: Topology, cfg: RunConfig, num_rows: Optional[int] = Non
         threshold = cfg.threshold + 1 if ref else cfg.threshold
         state = gossip_init(rows, seed_node)
         core = partial(
-            gossip_round, n=n, threshold=threshold, keep_alive=cfg.keep_alive
+            gossip_round, n=n, threshold=threshold, keep_alive=cfg.keep_alive,
+            all_alive=all_alive,
         )
         done_fn = gossip_done
         keep_alive = cfg.keep_alive
@@ -230,11 +256,12 @@ def build_protocol(topo: Topology, cfg: RunConfig, num_rows: Optional[int] = Non
             reference_semantics=ref,
             predicate=cfg.predicate,
             tol=cfg.tol,
+            all_alive=all_alive,
+            targets_alive=targets_alive,
         )
         done_fn = pushsum_done
         extra_stats = None
 
-    alive0 = initial_alive(topo)
     if alive0 is not None:
         if rows > n:
             alive0 = jnp.concatenate([alive0, jnp.zeros(rows - n, bool)])
@@ -245,7 +272,7 @@ def build_protocol(topo: Topology, cfg: RunConfig, num_rows: Optional[int] = Non
             alive=state.alive & ~pad_dead,
             converged=state.converged | pad_dead,
         )
-    return state, core, done_fn, extra_stats
+    return state, core, done_fn, extra_stats, (all_alive, targets_alive)
 
 
 def gossip_spreading_count(state: GossipState, keep_alive: bool) -> jax.Array:
@@ -417,7 +444,9 @@ def run_simulation(
 
     ``initial_state`` resumes from a checkpoint (SURVEY.md §5.4).
     """
-    state, round_core, done_fn, extra_stats = build_protocol(topo, cfg)
+    state, round_core, done_fn, extra_stats, _ = build_protocol(
+        topo, cfg, allow_all_alive=resume_allows_fast(topo, initial_state)
+    )
     if initial_state is not None:
         # copy: the chunk runner donates its input buffers, and consuming
         # the caller's arrays in-place would be a surprising API
@@ -459,3 +488,23 @@ def warm_start(step, state):
 def resume_simulation(topo: Topology, cfg: RunConfig, state) -> RunResult:
     """Continue a run from a checkpointed state (SURVEY.md §5.4)."""
     return run_simulation(topo, cfg, initial_state=state)
+
+
+def resume_allows_fast(topo: Topology, initial_state) -> bool:
+    """Can a resumed run keep the static liveness fast paths?
+
+    Yes iff the checkpoint's dead set is exactly the birth exclusions
+    (component-closed by construction) — i.e. the state a fresh run of
+    this topology would start from. A checkpoint from a faulted run
+    carries an arbitrary dead set; compiling out the liveness checks
+    there would silently resurrect the dead.
+    """
+    if initial_state is None:
+        return True
+    alive = np.asarray(jax.device_get(initial_state.alive))
+    if alive.all():
+        return True
+    a0 = initial_alive(topo)
+    return a0 is not None and np.array_equal(
+        alive[: topo.num_nodes], np.asarray(jax.device_get(a0))
+    )

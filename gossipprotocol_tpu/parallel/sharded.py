@@ -65,7 +65,12 @@ except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 
-def _sharded_core(topo: Topology, cfg: RunConfig):
+def _sharded_core(
+    topo: Topology,
+    cfg: RunConfig,
+    all_alive: bool = False,
+    targets_alive: bool = False,
+):
     """The round-core factory matching build_protocol's parameters but
     using the injectable-scatter cores (collective scatter plugged in by
     the chunk body)."""
@@ -77,6 +82,7 @@ def _sharded_core(topo: Topology, cfg: RunConfig):
             n=n,
             threshold=cfg.threshold + 1 if ref else cfg.threshold,
             keep_alive=cfg.keep_alive,
+            all_alive=all_alive,
         )
     return partial(
         pushsum_round_core,
@@ -87,6 +93,8 @@ def _sharded_core(topo: Topology, cfg: RunConfig):
         predicate=cfg.predicate,
         tol=cfg.tol,
         all_sum=lambda x: jax.lax.psum(jnp.sum(x), NODES_AXIS),
+        all_alive=all_alive,
+        targets_alive=targets_alive,
     )
 
 
@@ -135,7 +143,9 @@ def pad_neighbors(nbrs, n_padded: int):
     )
 
 
-def make_sharded_chunk_runner(topo: Topology, cfg: RunConfig, mesh: Mesh):
+def make_sharded_chunk_runner(
+    topo: Topology, cfg: RunConfig, mesh: Mesh, allow_all_alive: bool = True
+):
     """jitted ``(state, nbrs, seed, round_limit) -> state`` advancing one
     chunk under shard_map. Returns (runner, initial padded+placed state,
     placed nbrs, done_fn)."""
@@ -144,17 +154,29 @@ def make_sharded_chunk_runner(topo: Topology, cfg: RunConfig, mesh: Mesh):
     n_padded = padded_size(n, num_shards)
     local_n = n_padded // num_shards
 
-    state0, _, done_fn, _ = build_protocol(topo, cfg, num_rows=n_padded)
-    core = _sharded_core(topo, cfg)
+    # build_protocol's flag pair is the single source of truth for the
+    # liveness fast paths (padding rows are handled there via num_rows;
+    # they are never anyone's target, so targets_alive tolerates them)
+    state0, _, done_fn, _, (all_alive, targets_alive) = build_protocol(
+        topo, cfg, num_rows=n_padded, allow_all_alive=allow_all_alive
+    )
+    core = _sharded_core(
+        topo, cfg, all_alive=all_alive, targets_alive=targets_alive
+    )
     is_pushsum = cfg.algorithm != "gossip"
 
     def chunk_local(state_l, nbrs, seed, round_limit):
         base_key = jax.random.key(seed)
         shard = jax.lax.axis_index(NODES_AXIS)
         gids = shard * local_n + jnp.arange(local_n, dtype=jnp.int32)
-        # faults only strike between chunks, so the global aliveness mask is
-        # loop-invariant within a chunk: gather it once
-        alive_g = jax.lax.all_gather(state_l.alive, NODES_AXIS, tiled=True)
+        # faults only strike between chunks, so the global aliveness mask
+        # is loop-invariant within a chunk: gather it once. Only the
+        # push-sum general path ever reads it — gossip suppresses on the
+        # receiver side and the fast paths compile the lookup away.
+        alive_g = (
+            None if targets_alive or not is_pushsum
+            else jax.lax.all_gather(state_l.alive, NODES_AXIS, tiled=True)
+        )
 
         def scatter1(v, t):
             full = jax.ops.segment_sum(v, t, num_segments=n_padded)
@@ -281,8 +303,11 @@ def run_simulation_sharded(
     n = topo.num_nodes
     n_padded = padded_size(n, int(mesh.devices.size))
 
+    from gossipprotocol_tpu.engine.driver import resume_allows_fast
+
     runner, state, nbrs, done_fn, shardings = make_sharded_chunk_runner(
-        topo, cfg, mesh
+        topo, cfg, mesh,
+        allow_all_alive=resume_allows_fast(topo, initial_state),
     )
     if initial_state is not None:
         state = jax.device_put(pad_state(initial_state, n_padded), shardings)

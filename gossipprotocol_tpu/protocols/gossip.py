@@ -54,6 +54,7 @@ def gossip_round_core(
     scatter,
     threshold: int = 10,
     keep_alive: bool = True,
+    all_alive: bool = False,
 ) -> GossipState:
     """One synchronous round over the rows in ``gids``.
 
@@ -62,20 +63,24 @@ def gossip_round_core(
     ``segment_sum`` over the padded global length followed by
     ``psum_scatter`` back to local rows). Because per-node draws key on
     global ids, both layouts take bitwise-identical trajectories.
+
+    ``all_alive=True`` (static) compiles out the aliveness masks; legal
+    only when no node can ever be dead (see ``pushsum_round_core``).
     """
     key = jax.random.fold_in(base_key, state.round)
     targets, valid = sample_neighbors(nbrs, n, key, gids)
 
     heard = state.counts >= 1
     spreaders = heard if keep_alive else heard & ~state.converged
-    spreaders = spreaders & valid & state.alive
+    spreaders = spreaders & valid if all_alive else spreaders & valid & state.alive
 
     hits = scatter(spreaders.astype(state.counts.dtype), targets)
     # the reference's sender-side dict check (Program.fs:87-88) — no hits
     # land on converged or failed receivers. Suppressing on the receiver
     # side is outcome-identical and keeps the rule local to each shard
     # under shard_map (no all-gather of converged flags needed).
-    hits = jnp.where(state.converged | ~state.alive, 0, hits)
+    suppressed = state.converged if all_alive else state.converged | ~state.alive
+    hits = jnp.where(suppressed, 0, hits)
     counts = state.counts + hits
     converged = state.converged | (counts >= threshold)
     return GossipState(
@@ -86,7 +91,11 @@ def gossip_round_core(
     )
 
 
-@partial(jax.jit, static_argnames=("n", "threshold", "keep_alive"), inline=True)
+@partial(
+    jax.jit,
+    static_argnames=("n", "threshold", "keep_alive", "all_alive"),
+    inline=True,
+)
 def gossip_round(
     state: GossipState,
     nbrs,  # CSRNeighbors | DenseNeighbors | None (implicit full graph)
@@ -95,6 +104,7 @@ def gossip_round(
     n: int,
     threshold: int = 10,
     keep_alive: bool = True,
+    all_alive: bool = False,
 ) -> GossipState:
     """Single-chip round. ``nbrs``/``base_key`` are runtime arguments so one
     compiled executable serves every same-shape topology and seed."""
@@ -107,6 +117,7 @@ def gossip_round(
         scatter=lambda v, t: jax.ops.segment_sum(v, t, num_segments=n),
         threshold=threshold,
         keep_alive=keep_alive,
+        all_alive=all_alive,
     )
 
 

@@ -61,8 +61,24 @@ def pushsum_round_core(
     predicate: str = "delta",
     tol: float = 1e-4,
     all_sum=jnp.sum,
+    all_alive: bool = False,
+    targets_alive: bool = False,
 ) -> PushSumState:
     """One synchronous round over the rows in ``gids``.
+
+    Two static fast-path flags:
+
+    * ``all_alive=True`` compiles out every aliveness check — legal only
+      when no node can ever be dead (no fault plan, no birth exclusions,
+      no padding rows).
+    * ``targets_alive=True`` elides only the target-liveness lookup
+      ``alive_global[targets]`` — legal whenever the dead set is
+      *component-closed* (every alive node's neighbors are alive), which
+      holds for birth exclusions (whole components) as long as no fault
+      plan can later kill arbitrary nodes. The lookup is a full-length
+      random gather, measured ~90 ms/round at 10M nodes (~29 % of the
+      round), so this matters for the Erdős–Rényi north star where
+      isolated nodes make ``all_alive`` unattainable.
 
     ``scatter`` is injected (see ``gossip_round_core``); ``alive_global``
     is the full aliveness mask — push-sum needs the *target's* liveness at
@@ -87,7 +103,12 @@ def pushsum_round_core(
     key = jax.random.fold_in(base_key, state.round)
     targets, valid = sample_neighbors(nbrs, n, key, gids)
 
-    deliver = valid & state.alive & alive_global[targets]
+    if all_alive:
+        deliver = valid
+    elif targets_alive:
+        deliver = valid & state.alive
+    else:
+        deliver = valid & state.alive & alive_global[targets]
     s_sent = jnp.where(deliver, state.s * 0.5, jnp.zeros_like(state.s))
     w_sent = jnp.where(deliver, state.w * 0.5, jnp.zeros_like(state.w))
 
@@ -107,9 +128,10 @@ def pushsum_round_core(
         received = in_w > 0
         streak = jnp.where(received, state.streak + 1, state.streak)
     elif predicate == "global":
-        mean = all_sum(jnp.where(state.alive, s_new, 0)) / jnp.maximum(
-            all_sum(jnp.where(state.alive, w_new, 0)),
-            jnp.asarray(1e-30, w_new.dtype),
+        s_healthy = s_new if all_alive else jnp.where(state.alive, s_new, 0)
+        w_healthy = w_new if all_alive else jnp.where(state.alive, w_new, 0)
+        mean = all_sum(s_healthy) / jnp.maximum(
+            all_sum(w_healthy), jnp.asarray(1e-30, w_new.dtype)
         )
         near = jnp.abs(ratio_new - mean) <= tol
         streak = jnp.where(near, state.streak + 1, 0)
@@ -140,7 +162,8 @@ def pushsum_round_core(
 @partial(
     jax.jit,
     static_argnames=(
-        "n", "eps", "streak_target", "reference_semantics", "predicate", "tol",
+        "n", "eps", "streak_target", "reference_semantics", "predicate",
+        "tol", "all_alive", "targets_alive",
     ),
     inline=True,
 )
@@ -155,6 +178,8 @@ def pushsum_round(
     reference_semantics: bool = False,
     predicate: str = "delta",
     tol: float = 1e-4,
+    all_alive: bool = False,
+    targets_alive: bool = False,
 ) -> PushSumState:
     """Single-chip round. ``nbrs``/``base_key`` are runtime arguments so one
     compiled executable serves every same-shape topology and seed."""
@@ -178,6 +203,8 @@ def pushsum_round(
         reference_semantics=reference_semantics,
         predicate=predicate,
         tol=tol,
+        all_alive=all_alive,
+        targets_alive=targets_alive,
     )
 
 

@@ -3,6 +3,7 @@ round counts, metric plumbing, checkpoint/resume, fault plans."""
 
 import os
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -155,6 +156,73 @@ def test_minority_components_excluded_at_birth():
     alive = np.asarray(res.final_state.alive)
     assert list(alive) == [True, True, True, True, False, False]
     assert res.estimate_error is not None and res.estimate_error <= 2e-4
+
+
+def test_all_alive_fast_path_is_trajectory_identical():
+    """The fast path that compiles out aliveness masks must be bitwise
+    equal to the general path — for both protocols."""
+    from gossipprotocol_tpu.engine.driver import build_protocol
+
+    topo = build_topology("imp3D", 64, seed=3)
+    for algo, field in (("gossip", "counts"), ("push-sum", "s")):
+        cfg = RunConfig(algorithm=algo, seed=7, chunk_rounds=64)
+        fast = run_simulation(topo, cfg)
+        # force the general path via a no-op fault plan entry far past the
+        # horizon (non-empty plan disables the fast path; round never hit)
+        cfg_slow = RunConfig(
+            algorithm=algo, seed=7, chunk_rounds=64,
+            fault_plan={10**6 - 1: np.array([], dtype=np.int64)},
+        )
+        slow = run_simulation(topo, cfg_slow)
+        assert fast.rounds == slow.rounds, algo
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fast.final_state, field)),
+            np.asarray(getattr(slow.final_state, field)),
+            err_msg=algo,
+        )
+
+
+def test_resume_allows_fast_iff_dead_set_is_birth_only():
+    """Resuming keeps the liveness fast paths when the checkpoint's dead
+    set is exactly the birth exclusions; an arbitrary (faulted) dead set
+    forces the general path."""
+    from gossipprotocol_tpu.engine.driver import (
+        build_protocol,
+        initial_alive,
+        resume_allows_fast,
+    )
+
+    topo = build_topology("erdos_renyi", 300, seed=11, avg_degree=3.0)
+    assert initial_alive(topo) is not None
+    state, *_ = build_protocol(topo, RunConfig(algorithm="push-sum"))
+    assert resume_allows_fast(topo, None)
+    assert resume_allows_fast(topo, state)  # birth exclusions only
+    # kill one extra (giant-component) node -> arbitrary dead set
+    alive = np.asarray(state.alive).copy()
+    alive[int(np.flatnonzero(alive)[0])] = False
+    faulted = state._replace(alive=jnp.asarray(alive))
+    assert not resume_allows_fast(topo, faulted)
+
+
+def test_targets_alive_fast_path_on_er_with_exclusions():
+    """ER graphs have birth exclusions (so all_alive can't apply), but the
+    dead set is component-closed, so the target-liveness gather is elided
+    — trajectories must still match the general path bitwise."""
+    topo = build_topology("erdos_renyi", 300, seed=11, avg_degree=3.0)
+    from gossipprotocol_tpu.engine.driver import initial_alive
+
+    assert initial_alive(topo) is not None, "want a graph with exclusions"
+    cfg_fast = RunConfig(algorithm="push-sum", seed=7, chunk_rounds=64)
+    cfg_slow = RunConfig(
+        algorithm="push-sum", seed=7, chunk_rounds=64,
+        fault_plan={10**6 - 1: np.array([], dtype=np.int64)},
+    )
+    fast = run_simulation(topo, cfg_fast)
+    slow = run_simulation(topo, cfg_slow)
+    assert fast.rounds == slow.rounds
+    np.testing.assert_array_equal(
+        np.asarray(fast.final_state.s), np.asarray(slow.final_state.s)
+    )
 
 
 def test_auto_chunk_shrinks_for_float64():
