@@ -170,25 +170,11 @@ def initial_alive(topo: Topology) -> Optional[jax.Array]:
     cannot reach them, and push-sum averages per component — so they are
     excluded from the supervisor's predicate up front, the same mechanism
     as fault-injected nodes (majority-partition semantics,
-    :func:`gossipprotocol_tpu.utils.faults.kill_disconnected`).
+    :func:`gossipprotocol_tpu.utils.faults.kill_disconnected`; computed
+    and cached by :meth:`Topology.birth_alive`).
     None = everyone healthy."""
-    if topo.implicit_full or topo.kind in CONNECTED_BY_CONSTRUCTION:
-        return None
-    from gossipprotocol_tpu.utils.faults import kill_disconnected
-
-    alive = kill_disconnected(topo, np.ones(topo.num_nodes, dtype=bool))
-    if alive.all():
-        return None
-    return jnp.asarray(alive)
-
-
-# Builders whose output is connected for every input, so the birth-time
-# component check (a full scipy connected-components pass — seconds and
-# gigabytes of transient host RAM at 10M nodes) can be skipped: the path,
-# the lattices (imp3D only adds edges), and preferential attachment (each
-# new node attaches to an existing one). Erdős–Rényi and user-supplied
-# edge lists get the real check.
-CONNECTED_BY_CONSTRUCTION = frozenset({"line", "3D", "imp3D", "power_law"})
+    alive = topo.birth_alive()
+    return None if alive is None else jnp.asarray(alive)
 
 
 def build_protocol(

@@ -88,6 +88,37 @@ class Topology:
             return np.setdiff1d(np.arange(self.num_nodes, dtype=np.int32), [i])
         return self.indices[self.offsets[i] : self.offsets[i + 1]]
 
+    # builders whose output is connected for every input: the path, the
+    # lattices (imp3D only adds edges), preferential attachment (each new
+    # node attaches to an existing one)
+    _CONNECTED_KINDS = frozenset({"line", "3D", "imp3D", "power_law"})
+    _UNSET = object()
+
+    def birth_alive(self):
+        """bool[num_nodes] mask of the largest connected component, or
+        None when that is every node (majority-partition semantics:
+        minority components can never agree with the majority — see
+        ``utils.faults.kill_disconnected``).
+
+        Cached on the instance: the scipy component pass costs seconds at
+        10M nodes and repeated runs on one topology shouldn't repay it.
+        Kinds that are connected by construction skip the pass entirely.
+        """
+        cached = self.__dict__.get("_birth_alive_cache", Topology._UNSET)
+        if cached is not Topology._UNSET:
+            return cached
+        if self.implicit_full or self.kind in Topology._CONNECTED_KINDS:
+            result = None
+        else:
+            from gossipprotocol_tpu.utils.faults import kill_disconnected
+
+            alive = kill_disconnected(
+                self, np.ones(self.num_nodes, dtype=bool)
+            )
+            result = None if alive.all() else alive
+        object.__setattr__(self, "_birth_alive_cache", result)
+        return result
+
     def validate(self) -> None:
         """Structural sanity checks (used by tests and the CLI --check flag)."""
         if self.implicit_full:

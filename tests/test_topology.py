@@ -126,3 +126,18 @@ def test_registry_dispatch_and_aliases():
     # documented behavioral improvement)
     with pytest.raises(ValueError, match="unknown topology"):
         build_topology("mobius", 10)
+
+
+def test_birth_alive_cached_and_component_aware():
+    """birth_alive: None for connected-by-construction kinds, the giant
+    component for graphs with minorities, and computed only once."""
+    assert build_topology("imp3D", 64, seed=1).birth_alive() is None
+    # majority 4-cycle + minority pair
+    t = csr_from_edges(
+        6,
+        np.array([[0, 1], [1, 2], [2, 3], [3, 0], [4, 5]]),
+        kind="er-ish",
+    )
+    a1 = t.birth_alive()
+    assert list(a1) == [True, True, True, True, False, False]
+    assert t.birth_alive() is a1  # cached, not recomputed
