@@ -214,9 +214,20 @@ def build_protocol(
     # plan (or resumed dead set) can make the dead set component-open
     targets_alive = allow_all_alive and not cfg.fault_plan
     if cfg.algorithm == "gossip":
-        seed_node = (
-            pick_seed_node(n, cfg.seed) if cfg.seed_node is None else cfg.seed_node
-        )
+        if cfg.seed_node is not None:
+            seed_node = cfg.seed_node  # explicit: honored even if dead
+        else:
+            seed_node = pick_seed_node(n, cfg.seed)
+            birth = topo.birth_alive()  # host-side; no device round-trip
+            if birth is not None and not bool(birth[seed_node]):
+                # planting the rumor on a birth-excluded minority node
+                # would stall the whole run while the majority is healthy
+                # — redraw among the alive nodes (deterministic in seed)
+                alive_ids = np.flatnonzero(birth)
+                if alive_ids.size:
+                    seed_node = int(
+                        np.random.default_rng(cfg.seed ^ 0x5EED).choice(alive_ids)
+                    )
         # reference converges on the 11th hearing (Program.fs:91-92); the
         # intended rule is 10 (README.md:2)
         threshold = cfg.threshold + 1 if ref else cfg.threshold
@@ -232,7 +243,8 @@ def build_protocol(
         }
     else:
         state = pushsum_init(
-            rows, value_mode=cfg.value_mode, dtype=cfg.dtype, reference_semantics=ref
+            rows, value_mode=cfg.value_mode, dtype=cfg.dtype,
+            reference_semantics=ref, real_nodes=n,
         )
         core = partial(
             pushsum_round,
@@ -490,7 +502,5 @@ def resume_allows_fast(topo: Topology, initial_state) -> bool:
     alive = np.asarray(jax.device_get(initial_state.alive))
     if alive.all():
         return True
-    a0 = initial_alive(topo)
-    return a0 is not None and np.array_equal(
-        alive[: topo.num_nodes], np.asarray(jax.device_get(a0))
-    )
+    birth = topo.birth_alive()  # host-side; no device round-trip
+    return birth is not None and np.array_equal(alive[: topo.num_nodes], birth)

@@ -291,8 +291,16 @@ def run_simulation_sharded(
     initial_state=None,
     backend: Optional[str] = None,
 ) -> RunResult:
-    """Multi-chip ``run_simulation``: same semantics, same trajectories
-    (sharding-invariant PRNG), state sharded over the mesh.
+    """Multi-chip ``run_simulation``: same semantics, same trajectories,
+    state sharded over the mesh.
+
+    Invariance contract: per-node draws key on global ids, so every mesh
+    size samples identical targets. Gossip state is integer and therefore
+    bitwise-identical to single-chip. Push-sum values match up to float
+    accumulation order (per-device partial scatters + ``psum_scatter``
+    associate differently than one global scatter), i.e. to ~ulp — which
+    the eps-streak predicate can amplify into slightly different round
+    counts on threshold-crossing rounds.
 
     ``initial_state`` resumes from a (trimmed) checkpoint: it is re-padded
     to the mesh and takes over from its recorded round.

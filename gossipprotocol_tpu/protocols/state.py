@@ -68,6 +68,7 @@ def pushsum_init(
     value_mode: str = "scaled",
     dtype=jnp.float32,
     reference_semantics: bool = False,
+    real_nodes: int | None = None,
 ) -> PushSumState:
     """Initial push-sum state.
 
@@ -80,19 +81,33 @@ def pushsum_init(
         nodes on TPU (documented divergence; the *capability* is s/w →
         mean of initial values, SURVEY.md §2.4.2).
 
+    ``real_nodes``: the true node count N when ``num_nodes`` includes
+    sharding padding rows. The scale divisor and the zero-mass cutoff use
+    N, never the padded row count — otherwise a padded mesh would start
+    real nodes from different values than single-chip and break the
+    bitwise sharding-invariance guarantee (found by fuzzing: a 6-node
+    graph on 4 devices pads to 8 rows and s_i = i/8 ≠ i/6). Rows >= N get
+    s = 0, w = 0: phantom rows carry no mass.
+
     ``reference_semantics`` starts the streak counter at 1, mirroring the
     reference's ``count`` initialized to 1 (``Program.fs:67``), which —
     combined with its always-zero delta — makes a node "converge" on its
     2nd received message.
     """
+    n = real_nodes if real_nodes is not None else num_nodes
     i = jnp.arange(num_nodes, dtype=dtype)
-    s = i / num_nodes if value_mode == "scaled" else i
+    s = i / n if value_mode == "scaled" else i
     w = jnp.ones(num_nodes, dtype)
+    if num_nodes > n:
+        phantom = jnp.arange(num_nodes) >= n
+        s = jnp.where(phantom, 0, s)
+        w = jnp.where(phantom, 0, w)
     streak0 = 1 if reference_semantics else 0
     return PushSumState(
         s=s,
         w=w,
-        ratio=s / w,
+        # maximum guards the zero-weight phantom rows (0/0 -> NaN)
+        ratio=s / jnp.maximum(w, jnp.asarray(1e-30, dtype)),
         streak=jnp.full(num_nodes, streak0, jnp.int32),
         converged=jnp.zeros(num_nodes, bool),
         alive=jnp.ones(num_nodes, bool),

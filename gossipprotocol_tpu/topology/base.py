@@ -116,6 +116,10 @@ class Topology:
                 self, np.ones(self.num_nodes, dtype=bool)
             )
             result = None if alive.all() else alive
+        if result is not None:
+            # the cache hands out the same array to every caller — an
+            # in-place mutation would corrupt all later runs
+            result.setflags(write=False)
         object.__setattr__(self, "_birth_alive_cache", result)
         return result
 
