@@ -155,10 +155,23 @@ class RunResult:
         return float(np.abs(ratio[alive] - true_mean).max())
 
 
-def pick_seed_node(num_nodes: int, seed: int) -> int:
+def pick_seed_node(num_nodes: int, seed: int, alive=None) -> int:
     """Random gossip start node (reference: ``Random().Next(0, nodes)``,
-    ``Program.fs:193``) — derived from the run seed, reproducible."""
-    return int(np.random.default_rng(seed ^ 0x5EED).integers(0, num_nodes))
+    ``Program.fs:193``) — derived from the run seed, reproducible.
+
+    ``alive`` (bool mask or None): when the uniform pick lands on a
+    birth-excluded node, redraw among the alive ones — planting the rumor
+    in a minority component would stall the whole run while the majority
+    is healthy. One derivation owns the ``seed ^ 0x5EED`` stream so the
+    single-chip and sharded engines can never drift apart on it.
+    """
+    rng = np.random.default_rng(seed ^ 0x5EED)
+    node = int(rng.integers(0, num_nodes))
+    if alive is not None and not bool(alive[node]):
+        alive_ids = np.flatnonzero(alive)
+        if alive_ids.size:
+            node = int(rng.choice(alive_ids))
+    return node
 
 
 def initial_alive(topo: Topology) -> Optional[jax.Array]:
@@ -217,17 +230,7 @@ def build_protocol(
         if cfg.seed_node is not None:
             seed_node = cfg.seed_node  # explicit: honored even if dead
         else:
-            seed_node = pick_seed_node(n, cfg.seed)
-            birth = topo.birth_alive()  # host-side; no device round-trip
-            if birth is not None and not bool(birth[seed_node]):
-                # planting the rumor on a birth-excluded minority node
-                # would stall the whole run while the majority is healthy
-                # — redraw among the alive nodes (deterministic in seed)
-                alive_ids = np.flatnonzero(birth)
-                if alive_ids.size:
-                    seed_node = int(
-                        np.random.default_rng(cfg.seed ^ 0x5EED).choice(alive_ids)
-                    )
+            seed_node = pick_seed_node(n, cfg.seed, alive=topo.birth_alive())
         # reference converges on the 11th hearing (Program.fs:91-92); the
         # intended rule is 10 (README.md:2)
         threshold = cfg.threshold + 1 if ref else cfg.threshold

@@ -151,6 +151,39 @@ def test_sharded_pushsum_matches_single_chip_up_to_float_order(
     assert abs(w_total - n) < 1e-3 * max(n, 1)
 
 
+@given(
+    g=random_graph(max_nodes=32),
+    seed=st.integers(0, 2**31 - 1),
+    fault_round=st.integers(0, 40),
+    kill=st.lists(st.integers(0, 31), min_size=1, max_size=10),
+)
+@settings(**SETTINGS)
+def test_random_fault_plans_conserve_mass_and_terminate(
+    g, seed, fault_round, kill
+):
+    """Arbitrary mid-run fault strikes: total mass over ALL rows (alive,
+    dead, stranded, minority) is conserved — faults strand mass, never
+    destroy it — and the run always terminates within budget (the
+    partition semantics must leave no unreachable node in the predicate)."""
+    n, edges = g
+    topo = csr_from_edges(n, edges, kind="fuzz")
+    ids = np.unique([k % n for k in kill]).astype(np.int64)
+    cfg = RunConfig(
+        algorithm="push-sum", seed=seed, chunk_rounds=16, max_rounds=512,
+        fault_plan={fault_round: ids},
+    )
+    res = run_simulation(topo, cfg)
+    st_ = res.final_state
+    w_total = float(np.asarray(st_.w, np.float64).sum())
+    assert abs(w_total - n) < 1e-3 * max(n, 1)
+    alive = np.asarray(st_.alive)
+    if res.rounds > fault_round:
+        # the strike actually happened (a run that converges at or before
+        # fault_round legitimately never applies it)
+        assert not alive[ids].any()
+    assert res.rounds <= 512
+
+
 @given(g=random_graph(max_nodes=24), seed=st.integers(0, 2**31 - 1))
 @settings(**SETTINGS)
 def test_checkpoint_roundtrip_preserves_trajectory(g, seed, tmp_path_factory):
