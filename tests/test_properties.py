@@ -13,7 +13,7 @@ Invariants:
 
 import numpy as np
 import pytest
-from hypothesis import HealthCheck, given, settings
+from hypothesis import HealthCheck, assume, example, given, settings
 from hypothesis import strategies as st
 
 from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
@@ -113,42 +113,102 @@ def test_sharded_gossip_bitwise_equals_single_chip(g, seed, devices, cpu_devices
     )
 
 
+# The 9-node star 0—{1,2,3,4} (+4 isolated), seed 2, 2 devices: hypothesis'
+# counterexample that falsified the previous, over-strong contract ("same
+# convergence round + close final ratios under the delta predicate"). A
+# 6e-8 psum_scatter association shift flips the hub's delta across
+# eps=1e-10 at round 3, so the sharded run's streak fires at round 6 vs 12
+# and the final ratios differ by 0.22 — the delta predicate's documented
+# dry-spell unsoundness (see test_pushsum.py), not an engine bug. Pinned
+# as @example on both replacement contracts below.
+STAR_COUNTEREXAMPLE = (9, np.array([[0, 1], [0, 2], [0, 3], [0, 4]]))
+
+
 @given(
     g=random_graph(max_nodes=32),
     seed=st.integers(0, 2**31 - 1),
     devices=st.sampled_from([2, 4, 8]),
 )
+@example(g=STAR_COUNTEREXAMPLE, seed=2, devices=2)
 @settings(**SETTINGS)
-def test_sharded_pushsum_matches_single_chip_up_to_float_order(
-    g, seed, devices, cpu_devices
-):
-    """Push-sum draws are sharding-invariant, but float accumulation order
-    differs between layouts (per-device partial scatters + psum_scatter vs
-    one global scatter), so values agree only to ~ulp — which the
-    eps-streak predicate can amplify into different round counts (found by
-    fuzzing: 27 vs 32 rounds from a 3e-8 difference). The contract is:
-    identical draws, same mean, final estimates equal to float tolerance,
-    mass conserved."""
+def test_sharded_pushsum_ulp_equal_at_equal_rounds(g, seed, devices, cpu_devices):
+    """The actual sharding-invariance theorem: at a *fixed* round budget
+    (early stop disabled via an unreachable streak target) the sharded
+    layout reproduces the single-chip state to float-accumulation order —
+    draws are identical, so the only divergence is scatter/psum_scatter
+    association, ~ulp per round. All quantities are nonnegative (no
+    cancellation), so relative error stays ulp-scale over the whole run."""
     from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
 
     n, edges = g
     topo = csr_from_edges(n, edges, kind="fuzz")
-    cfg = RunConfig(algorithm="push-sum", seed=seed, chunk_rounds=64,
-                    max_rounds=2048)
+    rounds = 48
+    cfg = RunConfig(algorithm="push-sum", seed=seed, chunk_rounds=16,
+                    max_rounds=rounds, streak_target=2**30)
     single = run_simulation(topo, cfg)
+    alive = np.asarray(single.final_state.alive)
+    # an (effectively) edgeless graph is all-dead-at-birth (largest
+    # component < 2 nodes): it converges vacuously at round 0 with no
+    # protocol to compare — nothing to test
+    assume(alive.any())
     sharded = run_simulation_sharded(
         topo, cfg, mesh=make_mesh(devices=cpu_devices[:devices])
     )
-    assert sharded.converged == single.converged
+    assert single.rounds == rounds and sharded.rounds == rounds
+    for field in ("s", "w", "ratio"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(sharded.final_state, field))[alive],
+            np.asarray(getattr(single.final_state, field))[alive],
+            rtol=1e-5, atol=1e-7, err_msg=field,
+        )
+    # mass conserved in the sharded layout too (phantom rows carry none)
+    w_total = float(np.asarray(sharded.final_state.w, np.float64).sum())
+    assert abs(w_total - n) < 1e-3 * max(n, 1)
+
+
+@given(
+    g=random_graph(max_nodes=24),
+    seed=st.integers(0, 2**31 - 1),
+    devices=st.sampled_from([2, 4, 8]),
+)
+@example(g=STAR_COUNTEREXAMPLE, seed=2, devices=2)
+@settings(**SETTINGS)
+def test_sharded_pushsum_converges_to_same_mean_under_global_predicate(
+    g, seed, devices, cpu_devices
+):
+    """Ratio-closeness *at convergence* is a theorem only under
+    ``predicate="global"``: there, convergence certifies every alive
+    estimate is within tol of the conserved true mean, so both layouts'
+    final ratios are within 2·tol of each other regardless of the exact
+    round either one stopped at. (Under the default delta predicate this
+    is falsifiable — see STAR_COUNTEREXAMPLE above.)"""
+    from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+    n, edges = g
+    topo = csr_from_edges(n, edges, kind="fuzz")
+    tol = 1e-4
+    cfg = RunConfig(algorithm="push-sum", seed=seed, chunk_rounds=256,
+                    max_rounds=8192, predicate="global", tol=tol)
+    single = run_simulation(topo, cfg)
+    # guard the budget edge: an ulp-shifted layout may cross the threshold
+    # a few rounds later; only a comfortable margin makes "both converge"
+    # a theorem rather than a race against max_rounds
+    # estimate_error is None on all-dead-at-birth (edgeless) graphs —
+    # vacuous convergence, nothing to compare
+    assume(single.converged and single.rounds < 7000
+           and single.estimate_error is not None)
+    sharded = run_simulation_sharded(
+        topo, cfg, mesh=make_mesh(devices=cpu_devices[:devices])
+    )
+    assert sharded.converged
+    assert single.estimate_error <= tol * 1.01
+    assert sharded.estimate_error <= tol * 1.01
     alive = np.asarray(single.final_state.alive)
     np.testing.assert_allclose(
         np.asarray(sharded.final_state.ratio)[alive],
         np.asarray(single.final_state.ratio)[alive],
-        atol=1e-4,
+        atol=2.05 * tol,
     )
-    # mass conserved in the sharded layout too (phantom rows carry none)
-    w_total = float(np.asarray(sharded.final_state.w, np.float64).sum())
-    assert abs(w_total - n) < 1e-3 * max(n, 1)
 
 
 @given(

@@ -118,6 +118,39 @@ def test_global_predicate_sound_on_line():
     assert delta_res.estimate_error > 0.01
 
 
+def test_delta_predicate_dry_spell_unsound_on_star():
+    """The delta predicate's second unsoundness mode (beyond line-graph
+    drift): a node that sends but *receives* nothing keeps s/w exactly
+    constant — both halve — so its delta is exactly zero, and any node
+    with a streak_target-round dry spell "converges" regardless of how far
+    its estimate is from the mean. On a star 0—{1,2,3,4}, the hub targets
+    each leaf w.p. 1/4 per round, so a leaf sees a 3-round dry spell with
+    probability (3/4)^3 ≈ 0.42 per window — this is the mode that bites
+    hub-heavy (ER / power-law) graphs, where it was first observed as a
+    0.22 final-ratio gap (tests/test_properties.py STAR_COUNTEREXAMPLE).
+    The global predicate is immune: it measures distance to the conserved
+    true mean, not per-round movement."""
+    from gossipprotocol_tpu import RunConfig, run_simulation
+    from gossipprotocol_tpu.topology import csr_from_edges
+
+    edges = np.array([[0, 1], [0, 2], [0, 3], [0, 4]])
+    topo = csr_from_edges(9, edges, kind="fuzz")
+    delta_res = run_simulation(
+        topo, RunConfig(algorithm="push-sum", seed=0, max_rounds=2048)
+    )
+    # "converged" after a leaf's dry spell, with a wildly wrong estimate
+    assert delta_res.converged
+    assert delta_res.estimate_error > 0.05
+    tol = 1e-4
+    global_res = run_simulation(
+        topo,
+        RunConfig(algorithm="push-sum", seed=0, predicate="global", tol=tol,
+                  max_rounds=2048),
+    )
+    assert global_res.converged
+    assert global_res.estimate_error <= tol * 1.01
+
+
 def test_global_predicate_sharded(cpu_devices):
     from gossipprotocol_tpu import RunConfig
     from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
