@@ -11,7 +11,10 @@ One artifact exercising the whole aux stack under load, in four acts:
      (reduced scale — the multi-chip semantics check without hardware),
   5. run the power-law variant at full scale (BASELINE.md:36-37 names
      both graphs; power-law exceeds DENSE_MAX_DEGREE, so this also
-     exercises the CSR sampling path at 10M).
+     exercises the CSR sampling path at 10M) — first the reference's
+     single-target send (bounded: provably O(max_degree) rounds on a hub
+     graph), then fanout-all diffusion (``--fanout all``), which
+     converges at mixing time and certifies the mean to tol.
 
 Writes ``artifacts/northstar_pushsum_er.jsonl`` (per-chunk records for
 the full interrupted+resumed run) and
@@ -152,6 +155,22 @@ def main():
     # Quantified here; act 5b shows float64 removes it.
     pl_drift = abs(pl_mass - topo_pl.num_nodes) / topo_pl.num_nodes
 
+    # --- act 5c: power-law to ACTUAL convergence via fanout-all diffusion -
+    # The single-target send above is the reference's accidental behavior
+    # (Program.fs:128); the claimed capability is averaging. Diffusion
+    # (--fanout all: every node ships a 1/(deg+1) share to every neighbor,
+    # delivery = one segment_sum over the 80M-edge list) converges at
+    # graph mixing time, so THIS config certifies the mean at 10M
+    # power-law — closing the one BASELINE row the single-target variant
+    # provably cannot (VERDICT r2 missing #1).
+    print("[northstar] act 5c: power-law fanout-all diffusion ...", flush=True)
+    res_pld = run_simulation(topo_pl, RunConfig(
+        algorithm="push-sum", seed=0, predicate="global", tol=1e-4,
+        fanout="all", chunk_rounds=32, max_rounds=2_000,
+    ))
+    pld_mass = float(np.asarray(res_pld.final_state.w, np.float64).sum())
+    pld_drift = abs(pld_mass - topo_pl.num_nodes) / topo_pl.num_nodes
+
     print("[northstar] act 5b: power-law float64 numerics ...", flush=True)
     import jax.numpy as jnp
 
@@ -201,7 +220,16 @@ def main():
                     "convergence O(max_degree) rounds — capability demo, "
                     "error-at-budget reported. f32 scatter-add into the "
                     "degree-1M hub leaks w at ulp scale (quantified); "
-                    "--x64 eliminates it (also quantified)",
+                    "--x64 eliminates it (also quantified). The fanout-all "
+                    "diffusion entry below is the variant that actually "
+                    "certifies the mean on this graph",
+            "diffusion_fanout_all": {
+                "rounds": res_pld.rounds,
+                "converged": res_pld.converged,
+                "wall_s": round(res_pld.wall_ms / 1e3, 2),
+                "estimate_error": res_pld.estimate_error,
+                "mass_drift_f32": pld_drift,
+            },
         },
         "backend": jax.default_backend(),
     }
@@ -215,6 +243,12 @@ def main():
     # f64 conserves mass to float64 rounding (SURVEY.md §7 hard part d)
     assert pl_drift < 0.02, f"f32 hub drift grew: {pl_drift}"
     assert pl64_drift < 1e-9, f"f64 should conserve mass: {pl64_drift}"
+    # the north-star closure: power-law 10M actually certifies the mean
+    assert res_pld.converged, "diffusion power-law must converge"
+    assert res_pld.estimate_error <= 1.01e-4, res_pld.estimate_error
+    # diffusion keeps the hub's w at ~n·deg/2E (~2^17), far from the f32
+    # ulp cliff the single-target variant hits, so mass holds tight
+    assert pld_drift < 1e-4, f"diffusion f32 drift: {pld_drift}"
 
 
 if __name__ == "__main__":

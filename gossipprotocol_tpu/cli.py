@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "conservation)")
     p.add_argument("--tol", type=float, default=1e-4,
                    help="tolerance for --predicate global")
+    p.add_argument("--fanout", choices=["one", "all"], default="one",
+                   help="push-sum sender: one random neighbor per round "
+                        "(the reference's send, Program.fs:128) or the "
+                        "fanout-all diffusion variant that converges at "
+                        "graph mixing time (required for hub-heavy graphs "
+                        "like power-law at scale)")
     p.add_argument("--value-mode", choices=["scaled", "index"], default="scaled",
                    help="push-sum init: i/N (TPU-safe) or the reference's s_i=i")
     p.add_argument("--x64", action="store_true",
@@ -187,6 +193,7 @@ def main(argv=None) -> int:
         semantics=args.semantics,
         predicate=args.predicate,
         tol=args.tol,
+        fanout=args.fanout,
         value_mode=args.value_mode,
         max_rounds=args.max_rounds,
         chunk_rounds=args.chunk_rounds,

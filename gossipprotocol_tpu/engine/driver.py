@@ -71,6 +71,9 @@ class RunConfig:
     predicate: str = "delta"       # push-sum: "delta" (reference-intended,
                                    # local) | "global" (sound; see pushsum.py)
     tol: float = 1e-4              # push-sum global-predicate tolerance
+    fanout: str = "one"            # push-sum sender: "one" (reference's
+                                   # single-target send, Program.fs:128) |
+                                   # "all" (diffusion; see diffusion.py)
     value_mode: str = "scaled"     # push-sum init: "scaled" (i/N) | "index" (i)
     dtype: Any = jnp.float32
     max_rounds: int = 1_000_000
@@ -99,6 +102,14 @@ class RunConfig:
             raise ValueError(
                 "predicate='global' is incompatible with semantics='reference' "
                 "(the reference's accidental rule ignores the estimate entirely)"
+            )
+        if self.fanout not in ("one", "all"):
+            raise ValueError("fanout must be 'one' or 'all'")
+        if self.fanout == "all" and self.semantics == "reference":
+            raise ValueError(
+                "fanout='all' is incompatible with semantics='reference': the "
+                "single-target send IS the reference's accidental behavior "
+                "(Program.fs:128) that the diffusion variant replaces"
             )
 
     def resolve_chunk_rounds(self, num_nodes: int) -> int:
@@ -237,7 +248,7 @@ def build_protocol(
         state = gossip_init(rows, seed_node)
         core = partial(
             gossip_round, n=n, threshold=threshold, keep_alive=cfg.keep_alive,
-            all_alive=all_alive,
+            all_alive=all_alive, inverted=gossip_inversion_enabled(topo, cfg),
         )
         done_fn = gossip_done
         keep_alive = cfg.keep_alive
@@ -249,17 +260,33 @@ def build_protocol(
             rows, value_mode=cfg.value_mode, dtype=cfg.dtype,
             reference_semantics=ref, real_nodes=n,
         )
-        core = partial(
-            pushsum_round,
-            n=n,
-            eps=cfg.eps,
-            streak_target=cfg.streak_target,
-            reference_semantics=ref,
-            predicate=cfg.predicate,
-            tol=cfg.tol,
-            all_alive=all_alive,
-            targets_alive=targets_alive,
-        )
+        if cfg.fanout == "all":
+            from gossipprotocol_tpu.protocols.diffusion import (
+                pushsum_diffusion_round,
+            )
+
+            core = partial(
+                pushsum_diffusion_round,
+                n=n,
+                eps=cfg.eps,
+                streak_target=cfg.streak_target,
+                predicate=cfg.predicate,
+                tol=cfg.tol,
+                all_alive=all_alive,
+                targets_alive=targets_alive,
+            )
+        else:
+            core = partial(
+                pushsum_round,
+                n=n,
+                eps=cfg.eps,
+                streak_target=cfg.streak_target,
+                reference_semantics=ref,
+                predicate=cfg.predicate,
+                tol=cfg.tol,
+                all_alive=all_alive,
+                targets_alive=targets_alive,
+            )
         done_fn = pushsum_done
         extra_stats = None
 
@@ -274,6 +301,43 @@ def build_protocol(
             converged=state.converged | pad_dead,
         )
     return state, core, done_fn, extra_stats, (all_alive, targets_alive)
+
+
+def gossip_inversion_enabled(topo: Topology, cfg: RunConfig) -> bool:
+    """Compile gossip with the gather-inverted delivery branch?
+
+    On for every dense-table gossip run (``GOSSIP_TPU_INVERT=0`` opts
+    out). Legality is *runtime*-checked on device each round (the branch
+    is taken only while every eligible node is spreading), so no static
+    condition beyond "the dense table and its inversion tables exist" is
+    needed — faults, birth exclusions, and ``keep_alive=False`` simply
+    keep the scatter branch selected.
+    """
+    import os
+
+    from gossipprotocol_tpu.protocols.sampling import use_dense
+
+    return (
+        cfg.algorithm == "gossip"
+        and os.environ.get("GOSSIP_TPU_INVERT", "1") != "0"
+        and use_dense(topo)
+    )
+
+
+def device_arrays(topo: Topology, cfg: RunConfig):
+    """The runtime adjacency pytree the chunk runner threads through:
+    sampled neighbor tables for the single-target senders (plus the
+    reverse-slot inversion tables for dense gossip), the edge list for
+    fanout-all diffusion (which draws nothing and walks every edge)."""
+    if cfg.algorithm == "push-sum" and cfg.fanout == "all":
+        from gossipprotocol_tpu.protocols.diffusion import diffusion_edges
+
+        return diffusion_edges(topo)
+    if gossip_inversion_enabled(topo, cfg):
+        from gossipprotocol_tpu.protocols.gossip import inverted_dense
+
+        return inverted_dense(topo)
+    return device_topology(topo)
 
 
 def gossip_spreading_count(state: GossipState, keep_alive: bool) -> jax.Array:
@@ -452,7 +516,7 @@ def run_simulation(
         # copy: the chunk runner donates its input buffers, and consuming
         # the caller's arrays in-place would be a surprising API
         state = jax.tree.map(jnp.array, initial_state)
-    nbrs = device_topology(topo)
+    nbrs = device_arrays(topo, cfg)
     base_key = jax.random.key(cfg.seed)
     runner = make_chunk_runner(round_core, done_fn, extra_stats)
 

@@ -54,9 +54,16 @@ from gossipprotocol_tpu.parallel.mesh import (
     padded_size,
     replicated,
 )
+from gossipprotocol_tpu.protocols.diffusion import (
+    pushsum_diffusion_round_core,
+    sharded_diffusion_edges,
+)
 from gossipprotocol_tpu.protocols.gossip import gossip_round_core
 from gossipprotocol_tpu.protocols.pushsum import pushsum_round_core
-from gossipprotocol_tpu.protocols.sampling import DenseNeighbors, device_topology
+from gossipprotocol_tpu.protocols.sampling import (
+    DenseNeighbors,
+    InvertedDense,
+)
 from gossipprotocol_tpu.topology.base import Topology
 
 try:  # jax >= 0.6 exposes shard_map at top level
@@ -76,13 +83,30 @@ def _sharded_core(
     the chunk body)."""
     ref = cfg.semantics == "reference"
     n = topo.num_nodes
+    all_sum = lambda x: jax.lax.psum(jnp.sum(x), NODES_AXIS)  # noqa: E731
     if cfg.algorithm == "gossip":
+        from gossipprotocol_tpu.engine.driver import gossip_inversion_enabled
+
         return partial(
             gossip_round_core,
             n=n,
             threshold=cfg.threshold + 1 if ref else cfg.threshold,
             keep_alive=cfg.keep_alive,
             all_alive=all_alive,
+            inverted=gossip_inversion_enabled(topo, cfg),
+            all_sum=all_sum,
+        )
+    if cfg.fanout == "all":
+        return partial(
+            pushsum_diffusion_round_core,
+            n=n,
+            eps=cfg.eps,
+            streak_target=cfg.streak_target,
+            predicate=cfg.predicate,
+            tol=cfg.tol,
+            all_sum=all_sum,
+            all_alive=all_alive,
+            targets_alive=targets_alive,
         )
     return partial(
         pushsum_round_core,
@@ -92,7 +116,7 @@ def _sharded_core(
         reference_semantics=ref,
         predicate=cfg.predicate,
         tol=cfg.tol,
-        all_sum=lambda x: jax.lax.psum(jnp.sum(x), NODES_AXIS),
+        all_sum=all_sum,
         all_alive=all_alive,
         targets_alive=targets_alive,
     )
@@ -124,23 +148,22 @@ def pad_state(state, n_padded: int):
 
 
 def pad_neighbors(nbrs, n_padded: int):
-    """Dense tables shard row-wise with the state, so they pad the same
-    way: phantom rows get degree 0 and are never sampled. CSR stays
+    """Dense (and inverted-dense) tables shard row-wise with the state, so
+    they pad the same way: phantom rows get degree 0 and are never sampled
+    (nor counted by the inversion — ``k_valid`` masks them). CSR stays
     replicated and untouched."""
-    if not isinstance(nbrs, DenseNeighbors):
+    if not isinstance(nbrs, (DenseNeighbors, InvertedDense)):
         return nbrs
     rows = int(nbrs.table.shape[0])
     if rows == n_padded:
         return nbrs
     extra = n_padded - rows
-    return DenseNeighbors(
-        table=jnp.concatenate(
-            [nbrs.table, jnp.zeros((extra, nbrs.table.shape[1]), nbrs.table.dtype)]
-        ),
-        degree=jnp.concatenate(
-            [nbrs.degree, jnp.zeros(extra, nbrs.degree.dtype)]
-        ),
-    )
+
+    def pad(x):
+        fill_shape = (extra,) + x.shape[1:]
+        return jnp.concatenate([x, jnp.zeros(fill_shape, x.dtype)])
+
+    return type(nbrs)(*(pad(v) for v in nbrs))
 
 
 def make_sharded_chunk_runner(
@@ -196,7 +219,14 @@ def make_sharded_chunk_runner(
             )
             return loc[:, 0], loc[:, 1]
 
-        if is_pushsum:
+        if is_pushsum and cfg.fanout == "all":
+            # diffusion: no draws, no gids — edges are pre-localized by
+            # source block, delivery is the same scatter2 collective
+            round_fn = partial(
+                core, nbrs=nbrs, base_key=base_key,
+                scatter=scatter2, alive_global=alive_g,
+            )
+        elif is_pushsum:
             round_fn = partial(
                 core, nbrs=nbrs, base_key=base_key, gids=gids,
                 scatter=scatter2, alive_global=alive_g,
@@ -252,12 +282,22 @@ def make_sharded_chunk_runner(
         return final, stats
 
     specs = _state_specs(state0)
-    nbrs = pad_neighbors(device_topology(topo), n_padded)
-    # dense adjacency rows align with the state rows -> shard over "nodes"
-    # (each device holds only its own rows); CSR replicates (its flat index
-    # pool can't split along node boundaries)
-    nbrs_dense = isinstance(nbrs, DenseNeighbors)
-    nbrs_specs = jax.tree.map(lambda _: P(NODES_AXIS) if nbrs_dense else P(), nbrs)
+    if is_pushsum and cfg.fanout == "all":
+        # every leaf of the edge pytree is built as equal per-device
+        # blocks (edges by source block, degree row-aligned) -> all shard
+        nbrs = sharded_diffusion_edges(topo, n_padded, num_shards)
+        nbrs_sharded = nbrs is not None  # None = implicit complete graph
+    else:
+        from gossipprotocol_tpu.engine.driver import device_arrays
+
+        nbrs = pad_neighbors(device_arrays(topo, cfg), n_padded)
+        # dense adjacency rows align with the state rows -> shard over
+        # "nodes" (each device holds only its own rows); CSR replicates
+        # (its flat index pool can't split along node boundaries)
+        nbrs_sharded = isinstance(nbrs, (DenseNeighbors, InvertedDense))
+    nbrs_specs = jax.tree.map(
+        lambda _: P(NODES_AXIS) if nbrs_sharded else P(), nbrs
+    )
 
     stats_fields = ["round", "done", "converged", "alive"]
     if cfg.algorithm != "gossip":
@@ -278,7 +318,7 @@ def make_sharded_chunk_runner(
     state0 = jax.device_put(state0, shardings)
     if nbrs is not None:
         nbrs = jax.device_put(
-            nbrs, node_sharding(mesh) if nbrs_dense else replicated(mesh)
+            nbrs, node_sharding(mesh) if nbrs_sharded else replicated(mesh)
         )
     return runner, state0, nbrs, done_fn, shardings
 

@@ -1,0 +1,262 @@
+"""Fanout-all diffusion push-sum (``--fanout all``).
+
+The reference's sender emits exactly **one** message per handler
+invocation (``Program.fs:128``) — a quirk of its actor loop, not the
+claimed capability (distributed averaging). Single-target push-sum needs
+O(max_degree) rounds to drain a hub on power-law graphs (each incoming
+edge delivers with probability 1/deg per round), which makes the 10M-node
+power-law north-star config unreachable under any round budget. The
+diffusion variant implemented here is the standard fix: every round, every
+node keeps ``1/(deg+1)`` of its ``(s, w)`` and ships one ``1/(deg+1)``
+share to *each* neighbor. That is exactly the lazy random-walk transition
+matrix ``P = (I + A·D⁻¹)/…`` applied to the mass vectors, so estimates
+converge at the graph's mixing time — O(log n / spectral gap), ~tens of
+rounds on Barabási–Albert graphs — while conserving Σs, Σw exactly like
+the single-target variant.
+
+TPU shape: no random draws at all. Delivery is one ``segment_sum`` over
+the symmetric CSR edge list (src sorted — XLA turns the per-edge share
+gather into near-sequential reads; the dst scatter is the same
+random-scatter kernel the single-target round pays, scaled E/N). Under
+``shard_map`` the edge list itself is sharded by source block (each
+device owns exactly the out-edges of its row block, host-localized
+indices, padded to equal length), partial sums land in a full-length
+vector, and one ``psum_scatter`` delivers each device its own rows — the
+identical collective pattern as the single-target round.
+
+The complete graph needs no edges at all: every share goes everywhere, so
+``in_i = Σ_j share_j − share_i`` is two reductions (a ``psum`` under
+shard_map) — and K_n diffusion provably mixes in **one** round
+(``s_new_i = Σ_j s_j / n`` for every i).
+
+``semantics="reference"`` is rejected for this variant (`RunConfig`):
+the single-target send *is* the reference's accidental behavior that
+fanout-all replaces.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossipprotocol_tpu.protocols.pushsum import finish_pushsum_round
+from gossipprotocol_tpu.protocols.state import PushSumState
+from gossipprotocol_tpu.topology.base import Topology
+
+
+class DiffusionEdges(NamedTuple):
+    """Device-side edge list for fanout-all delivery (a pytree).
+
+    Single-chip: ``src``/``dst`` are the CSR (row, col) pairs, sorted by
+    src, all valid. Under ``shard_map`` the arrays are the concatenation
+    of per-device blocks (equal length, zero-padded): ``src`` is
+    **device-local** row indices, ``dst`` stays global (it feeds the
+    full-length scatter that ``psum_scatter`` then distributes).
+    ``degree`` is row-aligned with the state (shards with it).
+    """
+
+    src: jax.Array     # int32[E']  edge source, local row index
+    dst: jax.Array     # int32[E']  edge target, global node id
+    valid: jax.Array   # bool[E']   False on padding edges
+    degree: jax.Array  # int32[rows]
+
+
+def diffusion_edges(topo: Topology) -> Optional[DiffusionEdges]:
+    """Single-chip device arrays; None for the implicit complete graph."""
+    if topo.implicit_full:
+        return None
+    n = topo.num_nodes
+    src = np.repeat(np.arange(n, dtype=np.int32), np.diff(topo.offsets))
+    return DiffusionEdges(
+        src=jnp.asarray(src),
+        dst=jnp.asarray(topo.indices, dtype=jnp.int32),
+        valid=jnp.ones(src.shape[0], bool),
+        degree=jnp.asarray(topo.degree, dtype=jnp.int32),
+    )
+
+
+def sharded_diffusion_edges(
+    topo: Topology, n_padded: int, num_shards: int
+) -> Optional[DiffusionEdges]:
+    """Host-side split of the edge list by source row block.
+
+    Device ``d`` owns the out-edges of rows ``[d·local_n, (d+1)·local_n)``
+    — CSR order means that is one contiguous slice per device. Each block
+    is padded to the longest block's length so the leading axis splits
+    evenly over the mesh; ``src`` is localized (block offset subtracted)
+    because each device gathers shares from its *local* state rows.
+    """
+    if topo.implicit_full:
+        return None
+    n = topo.num_nodes
+    local_n = n_padded // num_shards
+    offsets = np.asarray(topo.offsets, dtype=np.int64)
+    src = np.repeat(np.arange(n, dtype=np.int64), np.diff(offsets))
+    dst = np.asarray(topo.indices, dtype=np.int32)
+    # edge index boundaries of each device's row block (rows >= n have no
+    # edges, so clipping the row range into [0, n] is exact)
+    bounds = offsets[np.clip(np.arange(num_shards + 1) * local_n, 0, n)]
+    counts = np.diff(bounds)
+    max_e = max(int(counts.max()), 1)
+    src_l = np.zeros((num_shards, max_e), dtype=np.int32)
+    dst_l = np.zeros((num_shards, max_e), dtype=np.int32)
+    valid = np.zeros((num_shards, max_e), dtype=bool)
+    for d in range(num_shards):
+        lo, hi = int(bounds[d]), int(bounds[d + 1])
+        c = hi - lo
+        src_l[d, :c] = src[lo:hi] - d * local_n
+        dst_l[d, :c] = dst[lo:hi]
+        valid[d, :c] = True
+    degree = np.zeros(n_padded, dtype=np.int32)
+    degree[:n] = topo.degree
+    return DiffusionEdges(
+        src=jnp.asarray(src_l.reshape(-1)),
+        dst=jnp.asarray(dst_l.reshape(-1)),
+        valid=jnp.asarray(valid.reshape(-1)),
+        degree=jnp.asarray(degree),
+    )
+
+
+def pushsum_diffusion_round_core(
+    state: PushSumState,
+    nbrs: Optional[DiffusionEdges],
+    base_key: jax.Array,
+    *,
+    n: int,
+    scatter,
+    alive_global,
+    eps: float = 1e-10,
+    streak_target: int = 3,
+    predicate: str = "delta",
+    tol: float = 1e-4,
+    all_sum=jnp.sum,
+    all_alive: bool = False,
+    targets_alive: bool = False,
+) -> PushSumState:
+    """One synchronous fanout-all round.
+
+    ``scatter(a_e, b_e, dst_e) -> (in_a, in_b)`` is injected like the
+    single-target round's: a plain ``segment_sum`` single-chip, partial
+    ``segment_sum`` + ``psum_scatter`` under ``shard_map``. The liveness
+    fast-path flags carry the exact same legality contract as
+    :func:`pushsum_round_core` (``all_alive``: nobody can die;
+    ``targets_alive``: the dead set is component-closed, so an alive
+    node's neighbors are alive and no per-edge target-liveness gather is
+    needed — dead→dead edges ship a zero share and deliver nothing).
+    """
+    del base_key  # deterministic: fanout-all draws nothing
+    dt = state.s.dtype
+
+    if nbrs is None:
+        # Implicit complete graph: in_i = Σ share − share_i. Mixes in one
+        # round (s_new_i = Σ s_j / A for every i).
+        if all_alive:
+            a_count = jnp.asarray(n, dt)
+            s_m, w_m = state.s, state.w
+        else:
+            a_count = jnp.maximum(
+                all_sum(state.alive.astype(dt)), jnp.asarray(1, dt)
+            )
+            s_m = jnp.where(state.alive, state.s, 0)
+            w_m = jnp.where(state.alive, state.w, 0)
+        share_s = s_m / a_count
+        share_w = w_m / a_count
+        in_s = all_sum(share_s) - share_s
+        in_w = all_sum(share_w) - share_w
+        sent_s = share_s * (a_count - 1)
+        sent_w = share_w * (a_count - 1)
+        if not all_alive:
+            in_s = jnp.where(state.alive, in_s, 0)
+            in_w = jnp.where(state.alive, in_w, 0)
+        return finish_pushsum_round(
+            state, state.s - sent_s + in_s, state.w - sent_w + in_w,
+            received=in_w > 0, eps=eps, streak_target=streak_target,
+            reference_semantics=False, predicate=predicate, tol=tol,
+            all_sum=all_sum, all_alive=all_alive,
+        )
+
+    rows = state.s.shape[0]
+    deg = nbrs.degree.astype(dt)
+    inv = 1 / (deg + 1)
+    share_s = state.s * inv
+    share_w = state.w * inv
+    if not all_alive:
+        share_s = jnp.where(state.alive, share_s, 0)
+        share_w = jnp.where(state.alive, share_w, 0)
+
+    # per-edge shares: src is sorted (CSR order), so this gather streams
+    es = share_s[nbrs.src]
+    ew = share_w[nbrs.src]
+    if all_alive or targets_alive:
+        deliver = nbrs.valid
+        sent_s = share_s * deg
+        sent_w = share_w * deg
+    else:
+        # arbitrary dead sets (mid-run faults): an edge delivers only if
+        # its target is alive; the sender keeps undelivered shares so
+        # mass stays conserved among all rows
+        deliver = nbrs.valid & alive_global[nbrs.dst]
+        cnt = jax.ops.segment_sum(
+            deliver.astype(dt), nbrs.src, num_segments=rows
+        )
+        sent_s = share_s * cnt
+        sent_w = share_w * cnt
+    zero = jnp.asarray(0, dt)
+    in_s, in_w = scatter(
+        jnp.where(deliver, es, zero), jnp.where(deliver, ew, zero), nbrs.dst
+    )
+    return finish_pushsum_round(
+        state, state.s - sent_s + in_s, state.w - sent_w + in_w,
+        received=in_w > 0, eps=eps, streak_target=streak_target,
+        reference_semantics=False, predicate=predicate, tol=tol,
+        all_sum=all_sum, all_alive=all_alive,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n", "eps", "streak_target", "predicate", "tol", "all_alive",
+        "targets_alive",
+    ),
+    inline=True,
+)
+def pushsum_diffusion_round(
+    state: PushSumState,
+    nbrs: Optional[DiffusionEdges],
+    base_key: jax.Array,
+    *,
+    n: int,
+    eps: float = 1e-10,
+    streak_target: int = 3,
+    predicate: str = "delta",
+    tol: float = 1e-4,
+    all_alive: bool = False,
+    targets_alive: bool = False,
+) -> PushSumState:
+    """Single-chip fanout-all round (same call shape as ``pushsum_round``)."""
+
+    def scatter(a, b, dst):
+        return (
+            jax.ops.segment_sum(a, dst, num_segments=n),
+            jax.ops.segment_sum(b, dst, num_segments=n),
+        )
+
+    return pushsum_diffusion_round_core(
+        state,
+        nbrs,
+        base_key,
+        n=n,
+        scatter=scatter,
+        alive_global=state.alive,
+        eps=eps,
+        streak_target=streak_target,
+        predicate=predicate,
+        tol=tol,
+        all_alive=all_alive,
+        targets_alive=targets_alive,
+    )

@@ -46,7 +46,7 @@ from gossipprotocol_tpu.topology.base import Topology
 
 def gossip_round_core(
     state: GossipState,
-    nbrs,  # CSRNeighbors | DenseNeighbors | None (implicit full graph)
+    nbrs,  # CSRNeighbors | DenseNeighbors | InvertedDense | None (implicit full)
     base_key: jax.Array,
     *,
     n: int,
@@ -55,6 +55,8 @@ def gossip_round_core(
     threshold: int = 10,
     keep_alive: bool = True,
     all_alive: bool = False,
+    inverted: bool = False,
+    all_sum=jnp.sum,
 ) -> GossipState:
     """One synchronous round over the rows in ``gids``.
 
@@ -66,15 +68,49 @@ def gossip_round_core(
 
     ``all_alive=True`` (static) compiles out the aliveness masks; legal
     only when no node can ever be dead (see ``pushsum_round_core``).
+
+    ``inverted=True`` (static; requires ``nbrs: InvertedDense``) adds the
+    gather-inverted delivery as a second, on-device-selected branch: when
+    *every eligible node is spreading* — the ``keep_alive`` steady state
+    after the rumor saturates, which dominates runtime at scale — the hit
+    histogram is computed receiver-side by :func:`hits_by_inversion`
+    (bitwise-equal to the scatter's, measured 3.6x faster at 1M nodes),
+    and the sample+scatter branch is skipped entirely. The legality
+    condition (``spreaders == valid`` for every row, reduced via
+    ``all_sum`` so every shard takes the same branch) is checked each
+    round on device, so saturation flips the fast path on mid-chunk and
+    a fault-killed node flips it back off automatically.
     """
     key = jax.random.fold_in(base_key, state.round)
-    targets, valid = sample_neighbors(nbrs, n, key, gids)
 
     heard = state.counts >= 1
     spreaders = heard if keep_alive else heard & ~state.converged
-    spreaders = spreaders & valid if all_alive else spreaders & valid & state.alive
+    if not all_alive:
+        spreaders = spreaders & state.alive
 
-    hits = scatter(spreaders.astype(state.counts.dtype), targets)
+    if inverted:
+        valid = nbrs.degree > 0
+        eligible_spreading = spreaders & valid
+        mismatches = all_sum(
+            (eligible_spreading != valid).astype(jnp.int32)
+        )
+
+        def deliver_inverted():
+            return hits_by_inversion(nbrs, key)
+
+        def deliver_scatter():
+            targets, valid_s = sample_neighbors(nbrs, n, key, gids)
+            return scatter(
+                (spreaders & valid_s).astype(state.counts.dtype), targets
+            )
+
+        hits = jax.lax.cond(
+            mismatches == 0, deliver_inverted, deliver_scatter
+        )
+    else:
+        targets, valid = sample_neighbors(nbrs, n, key, gids)
+        spreaders = spreaders & valid
+        hits = scatter(spreaders.astype(state.counts.dtype), targets)
     # the reference's sender-side dict check (Program.fs:87-88) — no hits
     # land on converged or failed receivers. Suppressing on the receiver
     # side is outcome-identical and keeps the rule local to each shard
@@ -93,18 +129,19 @@ def gossip_round_core(
 
 @partial(
     jax.jit,
-    static_argnames=("n", "threshold", "keep_alive", "all_alive"),
+    static_argnames=("n", "threshold", "keep_alive", "all_alive", "inverted"),
     inline=True,
 )
 def gossip_round(
     state: GossipState,
-    nbrs,  # CSRNeighbors | DenseNeighbors | None (implicit full graph)
+    nbrs,  # CSRNeighbors | DenseNeighbors | InvertedDense | None (implicit full)
     base_key: jax.Array,
     *,
     n: int,
     threshold: int = 10,
     keep_alive: bool = True,
     all_alive: bool = False,
+    inverted: bool = False,
 ) -> GossipState:
     """Single-chip round. ``nbrs``/``base_key`` are runtime arguments so one
     compiled executable serves every same-shape topology and seed."""
@@ -118,6 +155,7 @@ def gossip_round(
         threshold=threshold,
         keep_alive=keep_alive,
         all_alive=all_alive,
+        inverted=inverted,
     )
 
 
@@ -143,3 +181,104 @@ def gossip_done(state: GossipState) -> jax.Array:
     """Supervisor predicate (reference: ``counter = nodes`` in the scheduler
     actor, ``Program.fs:53``): every healthy node has converged."""
     return jnp.all(state.converged | ~state.alive)
+
+
+def reverse_slot_table(topo: Topology):
+    """Host-side inversion tables for gather-mode hit delivery.
+
+    For every dense-table slot ``(i, k)`` with neighbor ``j = table[i, k]``:
+
+    * ``rev[i, k]`` — the position of ``i`` inside row ``j``'s (sorted)
+      neighbor list, i.e. the slot ``j`` must draw for its message to land
+      on ``i``;
+    * ``deg_nbr[i, k]`` — ``degree[j]``, so ``j``'s slot draw can be
+      recomputed elementwise without gathering from the degree vector.
+
+    Built once per topology with one lexsort over the edge list: sorting
+    edges by (dst, src) groups each node v's *incoming* edges in exactly
+    the order of v's sorted neighbor row, so the rank of an edge within
+    its dst block IS the reverse slot. Tables are int8 — the dense path
+    is gated at max degree 32, so slots and degrees both fit.
+    """
+    import numpy as np
+
+    offsets = np.asarray(topo.offsets, dtype=np.int64)
+    indices = np.asarray(topo.indices, dtype=np.int64)
+    deg = np.asarray(topo.degree, dtype=np.int64)
+    n = topo.num_nodes
+    maxd = int(deg.max()) if deg.size else 1
+    assert maxd < 128, "reverse-slot tables are int8; dense path only"
+    row = np.repeat(np.arange(n, dtype=np.int64), np.diff(offsets))
+    # the inversion identifies slots by rank within the sorted row, so the
+    # CSR must be canonical (csr_from_edges guarantees it; cheap recheck)
+    interior = np.ones(len(row), dtype=bool)
+    starts = offsets[1:-1]  # first slot of each row (trailing empty rows
+    interior[starts[starts < len(row)]] = False  # index past the pool)
+    if len(row) > 1:
+        assert (np.diff(indices)[interior[1:]] > 0).all(), (
+            "reverse_slot_table requires sorted, deduplicated CSR rows"
+        )
+    order = np.lexsort((row, indices))
+    rev_slot = np.empty(len(row), dtype=np.int8)
+    rev_slot[order] = (
+        np.arange(len(row), dtype=np.int64) - offsets[indices[order]]
+    ).astype(np.int8)
+    mask = np.arange(max(maxd, 1))[None, :] < deg[:, None]
+    rev = np.zeros((n, max(maxd, 1)), dtype=np.int8)
+    rev[mask] = rev_slot
+    deg_nbr = np.zeros_like(rev)
+    deg_nbr[mask] = deg[indices].astype(np.int8)
+    return rev, deg_nbr
+
+
+def inverted_dense(topo: Topology):
+    """Device-side :class:`InvertedDense` (dense table + inversion tables)."""
+    from gossipprotocol_tpu.protocols.sampling import (
+        InvertedDense, dense_table,
+    )
+
+    table, deg = dense_table(topo)
+    rev, deg_nbr = reverse_slot_table(topo)
+    return InvertedDense(
+        table=jnp.asarray(table), degree=jnp.asarray(deg),
+        rev=jnp.asarray(rev), deg_nbr=jnp.asarray(deg_nbr),
+    )
+
+
+def hits_by_inversion(nbrs, key: jax.Array):
+    """Receiver-side hit counting — zero scatters, zero gathers.
+
+    Exact inversion of one round's scatter delivery **when every eligible
+    node is spreading** (the ``keep_alive=True`` steady state after the
+    rumor saturates): node i's hit count is the number of neighbors whose
+    recomputed draw points back at i,
+
+        hits_i = Σ_k [ slot(table[i,k]) == rev[i,k] ],   k < degree[i]
+
+    where ``slot(j)`` reuses the engine's counter-based draw (a pure
+    function of the round key and j's global id — the property the
+    reference's time-seeded ``System.Random()`` could never offer), so
+    the histogram is bitwise-identical to the scatter's. Everything is
+    elementwise over the static [rows, max_deg] tables
+    (``nbrs: InvertedDense``): under shard_map each device computes its
+    own rows' hits with **no collective at all** — draws key on the
+    *neighbor* ids already stored in the table, never on who holds them.
+    Measured (experiments/gather_invert.py, TPU v5e): 2.39 vs 8.69
+    ms/round at 1M imp3D — 3.6x past the "scatter floor".
+    """
+    from gossipprotocol_tpu.protocols.sampling import _per_node_randint
+
+    table = nbrs.table
+    shape = table.shape
+    slot = _per_node_randint(
+        key, table.reshape(-1),
+        jnp.maximum(nbrs.deg_nbr.reshape(-1), 1).astype(jnp.uint32),
+    ).reshape(shape)
+    k_valid = (
+        jnp.arange(shape[1], dtype=jnp.int32)[None, :]
+        < nbrs.degree[:, None]
+    )
+    return jnp.sum(
+        ((slot == nbrs.rev.astype(jnp.int32)) & k_valid).astype(jnp.int32),
+        axis=1,
+    )

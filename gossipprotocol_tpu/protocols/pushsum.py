@@ -116,16 +116,43 @@ def pushsum_round_core(
 
     s_new = state.s - s_sent + in_s
     w_new = state.w - w_sent + in_w
+    return finish_pushsum_round(
+        state, s_new, w_new, received=in_w > 0,
+        eps=eps, streak_target=streak_target,
+        reference_semantics=reference_semantics,
+        predicate=predicate, tol=tol, all_sum=all_sum, all_alive=all_alive,
+    )
 
-    # w stays strictly positive for every alive node (each keeps >= half of
-    # a positive weight); the maximum only guards dead/isolated rows.
+
+def finish_pushsum_round(
+    state: PushSumState,
+    s_new,
+    w_new,
+    received,
+    *,
+    eps: float,
+    streak_target: int,
+    reference_semantics: bool,
+    predicate: str,
+    tol: float,
+    all_sum,
+    all_alive: bool,
+) -> PushSumState:
+    """Shared round tail: estimate refresh + convergence predicate.
+
+    Used by both senders — the single-target random-walk round above and
+    the fanout-all diffusion round (:mod:`protocols.diffusion`) — so the
+    predicate semantics cannot drift between the two.
+    """
+    # w stays strictly positive for every alive node (each keeps a
+    # positive fraction of a positive weight); the maximum only guards
+    # dead/isolated rows.
     ratio_new = s_new / jnp.maximum(w_new, jnp.asarray(1e-30, w_new.dtype))
 
     if reference_semantics:
         # Program.fs:109-114: delta is computed after the commit and is
         # identically zero, so the counter advances on every received
         # message (here: every round with incoming mass).
-        received = in_w > 0
         streak = jnp.where(received, state.streak + 1, state.streak)
     elif predicate == "global":
         s_healthy = s_new if all_alive else jnp.where(state.alive, s_new, 0)

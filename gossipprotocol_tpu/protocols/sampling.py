@@ -39,6 +39,21 @@ class CSRNeighbors(NamedTuple):
     indices: jax.Array  # int32[E]
 
 
+class InvertedDense(NamedTuple):
+    """:class:`DenseNeighbors` plus the gather-inversion tables
+    (:func:`gossipprotocol_tpu.protocols.gossip.reverse_slot_table`):
+    ``rev[i,k]`` = the slot neighbor ``table[i,k]`` must draw to hit i;
+    ``deg_nbr[i,k]`` = that neighbor's degree (int8 — the dense path is
+    gated at max degree 32). Row-aligned with the state like the dense
+    table, so it shards the same way. Accepted anywhere
+    :class:`DenseNeighbors` is."""
+
+    table: jax.Array    # int32[rows, max_degree]
+    degree: jax.Array   # int32[rows]
+    rev: jax.Array      # int8[rows, max_degree]
+    deg_nbr: jax.Array  # int8[rows, max_degree]
+
+
 class DenseNeighbors(NamedTuple):
     """Padded dense adjacency ``table[i, k]`` = k-th neighbor of row i.
 
@@ -82,23 +97,29 @@ def dense_table(topo: Topology) -> "tuple":
     return table, deg
 
 
+def use_dense(topo: Topology) -> bool:
+    """Engine default: dense table when the max degree is bounded
+    (≤ ``DENSE_MAX_DEGREE``) and ``GOSSIP_TPU_DENSE`` doesn't disable it."""
+    import os
+
+    return (
+        not topo.implicit_full
+        and os.environ.get("GOSSIP_TPU_DENSE", "1") != "0"
+        and int(topo.degree.max() if topo.degree.size else 0)
+        <= DENSE_MAX_DEGREE
+    )
+
+
 def device_topology(topo: Topology, dense: Optional[bool] = None):
     """Topology → device arrays; None for the implicit complete graph.
 
     ``dense``: force the dense table (True) or CSR (False); default picks
-    dense when the max degree is bounded (≤ ``DENSE_MAX_DEGREE``) and the
-    ``GOSSIP_TPU_DENSE`` env var doesn't disable it.
+    dense per :func:`use_dense`.
     """
     if topo.implicit_full:
         return None
     if dense is None:
-        import os
-
-        dense = (
-            os.environ.get("GOSSIP_TPU_DENSE", "1") != "0"
-            and int(topo.degree.max() if topo.degree.size else 0)
-            <= DENSE_MAX_DEGREE
-        )
+        dense = use_dense(topo)
     if dense:
         table, deg = dense_table(topo)
         return DenseNeighbors(
@@ -165,7 +186,7 @@ def sample_neighbors(
     (CSR / dense / implicit-full) and all layouts (single-chip / sharded)
     take bitwise-identical trajectories.
     """
-    if isinstance(nbrs, DenseNeighbors):
+    if isinstance(nbrs, (DenseNeighbors, InvertedDense)):
         # rows of the table correspond 1:1 with the sampled rows by
         # contract (full table, or the local shard under shard_map)
         if gids is None:

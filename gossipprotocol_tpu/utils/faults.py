@@ -10,7 +10,7 @@ receive, and the supervisor's predicate ignores them.
 
 from __future__ import annotations
 
-from typing import Dict, Sequence
+from typing import Dict
 
 import numpy as np
 
@@ -81,12 +81,3 @@ def kill_disconnected(topo, alive: np.ndarray) -> np.ndarray:
         alive[:] = False
         return alive
     return alive & (labels == int(sizes.argmax()))
-
-
-def merge_plans(*plans: Dict[int, Sequence[int]]) -> Dict[int, np.ndarray]:
-    out: Dict[int, np.ndarray] = {}
-    for plan in plans:
-        for r, ids in plan.items():
-            prev = out.get(int(r), np.empty(0, dtype=np.int64))
-            out[int(r)] = np.unique(np.concatenate([prev, np.asarray(ids, np.int64)]))
-    return out

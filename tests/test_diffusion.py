@@ -1,0 +1,136 @@
+"""Fanout-all diffusion push-sum (``--fanout all``, protocols/diffusion.py).
+
+The variant exists because the reference's single-target send
+(``Program.fs:128``) needs O(max_degree) rounds on hub graphs; diffusion
+converges at graph mixing time. Same invariants as the single-target
+path: exact mass conservation, convergence to the achievable mean,
+sharding equivalence to float-accumulation order — plus the K_n
+one-round-mixing theorem and the faults general path.
+"""
+
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.topology import csr_from_edges
+
+
+def cfg_all(**kw):
+    base = dict(algorithm="push-sum", fanout="all", seed=0, chunk_rounds=32,
+                max_rounds=4096)
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def test_mass_conserved_and_converges_on_imp3d():
+    topo = build_topology("imp3D", 64)
+    res = run_simulation(topo, cfg_all(predicate="global", tol=1e-5))
+    assert res.converged
+    assert res.estimate_error <= 1.01e-5
+    st = res.final_state
+    w_total = float(np.asarray(st.w, np.float64).sum())
+    assert abs(w_total - st.w.shape[0]) < 1e-3
+
+
+def test_converges_at_mixing_time_on_star():
+    """The config class that motivates the variant: a hub graph where
+    single-target push-sum drains the hub one neighbor per round.
+    Diffusion reaches tol in tens of rounds; single-target provably can't
+    certify the mean under the same sound predicate budget."""
+    leaves = 32
+    edges = np.array([[0, i] for i in range(1, leaves + 1)])
+    topo = csr_from_edges(leaves + 1, edges, kind="fuzz")
+    res = run_simulation(topo, cfg_all(predicate="global", tol=1e-4))
+    assert res.converged
+    assert res.rounds < 200
+    assert res.estimate_error <= 1.01e-4
+
+
+def test_full_graph_mixes_in_one_round():
+    """K_n diffusion sets every node to the mean in a single round, so the
+    sound global predicate fires as soon as the streak allows."""
+    topo = build_topology("full", 64)
+    res = run_simulation(topo, cfg_all(predicate="global", tol=1e-6,
+                                       streak_target=3))
+    assert res.converged
+    assert res.rounds <= 4  # 1 mixing round + streak
+    assert res.estimate_error <= 1.01e-6
+
+
+def test_deterministic_and_matches_delta_predicate():
+    """No randomness: two runs are bitwise identical; the delta predicate
+    is usable too (every node with an alive neighbor receives every round,
+    so the dry-spell unsoundness mode cannot occur)."""
+    topo = build_topology("3D", 27)
+    a = run_simulation(topo, cfg_all())
+    b = run_simulation(topo, cfg_all())
+    assert a.rounds == b.rounds
+    np.testing.assert_array_equal(
+        np.asarray(a.final_state.s), np.asarray(b.final_state.s)
+    )
+
+
+def test_sharded_equals_single_chip_at_equal_rounds(cpu_devices):
+    """Same theorem as the single-target variant: identical trajectories
+    up to float accumulation order at a fixed round budget."""
+    from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+    topo = build_topology("powerlaw", 200, seed=7)
+    rounds = 40
+    cfg = cfg_all(max_rounds=rounds, streak_target=2**30, chunk_rounds=16)
+    single = run_simulation(topo, cfg)
+    for devices in (2, 8):
+        sharded = run_simulation_sharded(
+            topo, cfg, mesh=make_mesh(devices=cpu_devices[:devices])
+        )
+        assert sharded.rounds == single.rounds == rounds
+        np.testing.assert_allclose(
+            np.asarray(sharded.final_state.ratio),
+            np.asarray(single.final_state.ratio),
+            rtol=1e-5, atol=1e-7,
+        )
+
+
+def test_sharded_full_graph(cpu_devices):
+    from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+    topo = build_topology("full", 100)
+    res = run_simulation_sharded(
+        topo, cfg_all(predicate="global", tol=1e-6),
+        mesh=make_mesh(devices=cpu_devices[:8]),
+    )
+    assert res.converged
+    assert res.rounds <= 4
+    assert res.estimate_error <= 1.01e-6
+
+
+def test_faults_conserve_mass_general_path():
+    """Mid-run kills flip the engine onto the general (per-edge target
+    liveness) path: undelivered shares stay with the sender, dead mass is
+    stranded not destroyed, and the survivors still converge."""
+    topo = build_topology("imp3D", 64)
+    n = topo.num_nodes
+    cfg = cfg_all(predicate="global", tol=1e-4,
+                  fault_plan={5: np.arange(0, 8)})
+    res = run_simulation(topo, cfg)
+    st = res.final_state
+    w_total = float(np.asarray(st.w, np.float64).sum())
+    assert abs(w_total - n) < 1e-3
+    assert res.converged
+    alive = np.asarray(st.alive)
+    assert not alive[:8].any()
+    assert res.estimate_error <= 1.01e-4
+
+
+def test_fanout_all_rejects_reference_semantics():
+    with pytest.raises(ValueError, match="fanout='all'"):
+        RunConfig(algorithm="push-sum", fanout="all", semantics="reference")
+
+
+def test_cli_fanout_flag(capsys):
+    from gossipprotocol_tpu.cli import main
+
+    main(["400", "full", "push-sum", "--fanout", "all", "--predicate",
+          "global", "--quiet"])
+    out = capsys.readouterr().out
+    assert "Convergence Time:" in out

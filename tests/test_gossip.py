@@ -120,3 +120,104 @@ def test_fault_injection_excluded_from_predicate():
     assert (counts[dead] == 0).all()
     alive = np.asarray(state.alive)
     assert (counts[alive] >= 10).all()
+
+
+def test_hits_by_inversion_matches_scatter_histogram():
+    """The gather-inverted delivery (receiver recomputes its neighbors'
+    draws) reproduces the scatter-add histogram bitwise for any graph and
+    round key — the all-spreading steady-state fast path's core claim."""
+    from gossipprotocol_tpu.protocols.gossip import (
+        hits_by_inversion, inverted_dense,
+    )
+    from gossipprotocol_tpu.protocols.sampling import (
+        device_topology, sample_neighbors,
+    )
+    from gossipprotocol_tpu.topology import csr_from_edges
+
+    rng = np.random.default_rng(0)
+    for trial in range(5):
+        n = int(rng.integers(5, 60))
+        m = int(rng.integers(1, 3 * n))
+        edges = rng.integers(0, n, size=(m, 2))
+        topo = csr_from_edges(n, edges, kind="fuzz")
+        if topo.degree.max() == 0:
+            continue
+        nbrs = device_topology(topo, dense=True)
+        inv = inverted_dense(topo)
+        for r in range(3):
+            key = jax.random.fold_in(jax.random.key(trial), r)
+            targets, valid = sample_neighbors(nbrs, topo.num_nodes, key)
+            h_scatter = jax.ops.segment_sum(
+                valid.astype(jnp.int32), targets, num_segments=topo.num_nodes
+            )
+            h_inv = hits_by_inversion(inv, key)
+            np.testing.assert_array_equal(
+                np.asarray(h_scatter), np.asarray(h_inv)
+            )
+
+
+def test_inverted_engine_bitwise_equals_scatter_engine(monkeypatch):
+    """Full engine A/B: the on-device cond branch (gather inversion after
+    rumor saturation) must not change the trajectory at all — same rounds,
+    same counts, bitwise."""
+    from gossipprotocol_tpu import RunConfig, run_simulation
+
+    topo = build_topology("imp3D", 343, seed=0)
+    cfg = RunConfig(algorithm="gossip", seed=3, chunk_rounds=16)
+    res_inv = run_simulation(topo, cfg)  # inversion on by default
+    monkeypatch.setenv("GOSSIP_TPU_INVERT", "0")
+    res_scatter = run_simulation(topo, cfg)
+    assert res_inv.rounds == res_scatter.rounds
+    assert res_inv.converged and res_scatter.converged
+    np.testing.assert_array_equal(
+        np.asarray(res_inv.final_state.counts),
+        np.asarray(res_scatter.final_state.counts),
+    )
+
+
+def test_inverted_engine_with_faults_stays_exact(monkeypatch):
+    """With dead nodes the all-spreading condition is false, so the cond
+    keeps selecting the scatter branch — fault trajectories must be
+    bitwise identical with the inversion compiled in or out."""
+    from gossipprotocol_tpu import RunConfig, run_simulation
+
+    topo = build_topology("3D", 216, seed=0)
+    cfg = RunConfig(algorithm="gossip", seed=1, chunk_rounds=16,
+                    fault_plan={8: np.arange(0, 12)})
+    res_inv = run_simulation(topo, cfg)
+    monkeypatch.setenv("GOSSIP_TPU_INVERT", "0")
+    res_scatter = run_simulation(topo, cfg)
+    assert res_inv.rounds == res_scatter.rounds
+    np.testing.assert_array_equal(
+        np.asarray(res_inv.final_state.counts),
+        np.asarray(res_scatter.final_state.counts),
+    )
+
+
+def test_inverted_sharded_bitwise_equals_single(cpu_devices, monkeypatch):
+    """Sharded + inversion: per-device local hit computation (no
+    collective in the inverted branch) still reproduces the single-chip
+    trajectory bitwise, and matches the inversion-disabled sharded run."""
+    from gossipprotocol_tpu import RunConfig, run_simulation
+    from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+    topo = build_topology("imp3D", 343, seed=0)
+    cfg = RunConfig(algorithm="gossip", seed=5, chunk_rounds=32)
+    single = run_simulation(topo, cfg)
+    sharded = run_simulation_sharded(
+        topo, cfg, mesh=make_mesh(devices=cpu_devices[:8])
+    )
+    assert sharded.rounds == single.rounds
+    np.testing.assert_array_equal(
+        np.asarray(sharded.final_state.counts),
+        np.asarray(single.final_state.counts),
+    )
+    monkeypatch.setenv("GOSSIP_TPU_INVERT", "0")
+    sharded_off = run_simulation_sharded(
+        topo, cfg, mesh=make_mesh(devices=cpu_devices[:8])
+    )
+    assert sharded_off.rounds == single.rounds
+    np.testing.assert_array_equal(
+        np.asarray(sharded_off.final_state.counts),
+        np.asarray(single.final_state.counts),
+    )
