@@ -141,8 +141,45 @@ def main():
     fig.tight_layout()
     out2 = os.path.join(ART, "oracle_plot.png")
     fig.savefig(out2, dpi=150, facecolor=SURFACE)
+
+    # --- calibrated predicted-vs-published overlay ------------------------
+    # one events/ms constant per algorithm (anchor full@1000), applied to
+    # every point; published values exist only at n=1000, drawn as hollow
+    # diamonds on the predicted curves
+    fig, axes = plt.subplots(1, 2, figsize=(10, 4))
+    fig.patch.set_facecolor(SURFACE)
+    for ax, pred_col, pub_col, title in (
+        (axes[0], "predicted_gossip_ms", "published_gossip_ms",
+         "gossip — predicted reference ms"),
+        (axes[1], "predicted_pushsum_ms", "published_pushsum_ms",
+         "push-sum — predicted reference ms"),
+    ):
+        series = defaultdict(list)
+        published = []
+        for r in rows:
+            if r.get(pred_col):
+                series[r["topology"]].append(
+                    (int(r["nodes_requested"]), float(r[pred_col]))
+                )
+            if r.get(pub_col):
+                published.append(
+                    (r["topology"], int(r["nodes_requested"]),
+                     float(r[pub_col]))
+                )
+        _plot_series(ax, series, logy=True)
+        for topo, x, y in published:
+            ax.plot([x], [y], marker="D", markersize=7, mew=1.5,
+                    mfc="none", mec=SLOT[topo], linestyle="none")
+        _style_axis(ax, title, "predicted ms (log)")
+    fig.suptitle("Oracle counts x fitted events/ms (anchor full@1000) vs "
+                 "Report.pdf published points (diamonds)",
+                 color=INK, fontsize=10)
+    fig.tight_layout()
+    out3 = os.path.join(ART, "oracle_calibration_plot.png")
+    fig.savefig(out3, dpi=150, facecolor=SURFACE)
     print(out1)
     print(out2)
+    print(out3)
 
 
 if __name__ == "__main__":

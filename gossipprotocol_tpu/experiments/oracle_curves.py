@@ -25,6 +25,20 @@ import sys
 DEFAULT_NODES = "100,250,500,750,1000"
 DEFAULT_TOPOLOGIES = "line,full,3D,imp3D"
 
+# Report.pdf's published convergence times at n=1000, read off the plotted
+# points (BASELINE.md:16-23; single runs, unspecified student laptop).
+# These are the only published numbers in the whole reference.
+PUBLISHED_MS_AT_1000 = {
+    "gossip": {"full": 275.0, "imp3D": 1150.0, "3D": 1100.0, "line": 3700.0},
+    "push-sum": {"full": 500.0, "imp3D": 500.0, "3D": 1100.0, "line": 8400.0},
+}
+# One free constant per algorithm bridges oracle counts to the reference's
+# wall-clock: ms = events / (events per ms of Akka handler throughput).
+# Fitted on a single anchor point each — full@1000, the flattest and least
+# seed-noisy published curve — and applied unchanged everywhere else, so
+# every other predicted point is a genuine out-of-sample check.
+CALIBRATION_ANCHOR = ("full", 1000)
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="oracle_curves")
@@ -72,6 +86,44 @@ def main(argv=None) -> int:
             print(f"{topo_name:6s} n={n:5d} -> gossip ev "
                   f"{rows[-1]['gossip_events_median']:9d}  push-sum hops "
                   f"{rows[-1]['pushsum_hops_median']:9d}", file=sys.stderr)
+
+    # calibrate oracle counts -> predicted reference-ms (VERDICT r2
+    # missing #3): one events/ms constant per algorithm from the anchor
+    # point, then predicted and published columns side by side
+    anchor_topo, anchor_n = CALIBRATION_ANCHOR
+    anchor = next(
+        (r for r in rows
+         if r["topology"] == anchor_topo and r["nodes_requested"] == anchor_n),
+        None,
+    )
+    ev_per_ms = hop_per_ms = None
+    if anchor is not None:
+        ev_per_ms = (anchor["gossip_events_median"]
+                     / PUBLISHED_MS_AT_1000["gossip"][anchor_topo])
+        hop_per_ms = (anchor["pushsum_hops_median"]
+                      / PUBLISHED_MS_AT_1000["push-sum"][anchor_topo])
+        print(f"calibration (anchor {anchor_topo}@{anchor_n}): "
+              f"gossip {ev_per_ms:.1f} events/ms, "
+              f"push-sum {hop_per_ms:.1f} hops/ms", file=sys.stderr)
+    for r in rows:
+        pub_g = pub_p = ""
+        if r["nodes_requested"] == 1000:
+            pub_g = PUBLISHED_MS_AT_1000["gossip"].get(r["topology"], "")
+            pub_p = PUBLISHED_MS_AT_1000["push-sum"].get(r["topology"], "")
+        r["predicted_gossip_ms"] = (
+            round(r["gossip_events_median"] / ev_per_ms, 1)
+            if ev_per_ms else "")
+        r["predicted_pushsum_ms"] = (
+            round(r["pushsum_hops_median"] / hop_per_ms, 1)
+            if hop_per_ms else "")
+        # the published line push-sum point is a single run of a
+        # heavy-tailed quantity (2-cover time; oracle seeds span ~20x),
+        # so the min column is the fair band edge to compare against
+        r["predicted_pushsum_ms_min"] = (
+            round(r["pushsum_hops_min"] / hop_per_ms, 1)
+            if hop_per_ms else "")
+        r["published_gossip_ms"] = pub_g
+        r["published_pushsum_ms"] = pub_p
 
     with open(args.out, "w", newline="") as fh:
         w = csv.DictWriter(fh, fieldnames=list(rows[0].keys()))
