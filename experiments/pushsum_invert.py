@@ -1,0 +1,159 @@
+"""Measure scatter→gather inversion of push-sum (s, w) delivery.
+
+Round 3's gossip inversion (experiments/gather_invert.py) removed the
+scatter because hit *counts* need no values: receivers recompute their
+neighbors' draws from the counter-based PRNG and count matches — zero
+data moves from sender rows to receiver rows. Push-sum delivery does move
+data — each sender ships ``(s/2, w/2)`` to its drawn target
+(``Program.fs:125-128``'s halve-and-forward, vectorized) — so the
+inversion cannot be data-free, but it can swap the *kind* of data
+movement: instead of two uniform-random scatter-adds (read-modify-write
+traffic XLA serializes into the "scatter floor"), the receiver gathers
+its neighbors' values at **static** indices (the dense table, a topology
+constant) and keeps only the slots whose recomputed draw points back at
+itself:
+
+    in_s_i = Σ_k [ slot(nbr_k) == rev[i,k] ] · s[nbr_k] / 2    (w alike)
+
+One [N, max_deg, 2] gather at fixed indices + elementwise compare/reduce
+replaces both segment_sums. Static-index gathers are prefetchable
+streaming reads — the bet is that they beat random-write scatters.
+
+Exactness: the delivered multiset is identical to the scatter path's
+whenever every sender with a live target delivers — the engine's
+``all_alive`` / ``targets_alive`` fast-path regimes (no faults mid-run).
+Unlike the gossip histogram (ints, bitwise-equal), the float *sum order*
+differs from ``segment_sum``'s, so trajectories agree to accumulation
+order, not bitwise — which is why the engine exposes this as an explicit
+``delivery`` choice rather than an on-device auto-switch.
+
+This script measures the raw kernels at BENCH scale on the push-sum
+north-star graph family (Erdős–Rényi, avg degree 8) and checks
+agreement: elementwise ulp-closeness and conservation of the delivered
+mass.
+
+Usage:  python experiments/pushsum_invert.py [--nodes 1000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu import build_topology
+from gossipprotocol_tpu.protocols.gossip import inverted_dense
+from gossipprotocol_tpu.protocols.pushsum import received_by_inversion
+from gossipprotocol_tpu.protocols.sampling import (
+    device_topology, sample_neighbors,
+)
+
+
+def timed(fn, repeats=5):
+    fn()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def sync(x):
+    return float(jax.device_get(jnp.sum(jnp.asarray(x, jnp.float32))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1_000_000)
+    ap.add_argument("--topology", default="erdos_renyi")
+    args = ap.parse_args()
+
+    topo = build_topology(args.topology, args.nodes, seed=0)
+    n = topo.num_nodes
+    nbrs = device_topology(topo, dense=True)
+    key = jax.random.key(0)
+    print(f"nodes={n} max_deg={nbrs.table.shape[1]} "
+          f"backend={jax.default_backend()}")
+
+    t0 = time.perf_counter()
+    nbrs_inv = inverted_dense(topo)
+    print(f"reverse-slot table build: {(time.perf_counter()-t0)*1e3:.0f} ms "
+          "(host, once; shared with gossip's inversion)")
+
+    # mid-run-looking state: distinct per-node values so a wrong slot or a
+    # transposed index cannot cancel out in the comparison
+    s = (jnp.arange(n, dtype=jnp.float32) % 1009) / 1009.0 + 0.5
+    w = 1.0 + (jnp.arange(n, dtype=jnp.float32) % 313) / 313.0
+
+    valid = nbrs.degree > 0
+
+    # --- scatter delivery (the engine's current path) ---------------------
+    @jax.jit
+    def recv_scatter(key, s, w):
+        targets, v = sample_neighbors(nbrs, n, key)
+        s_sent = jnp.where(v, s * 0.5, 0)
+        w_sent = jnp.where(v, w * 0.5, 0)
+        return (
+            jax.ops.segment_sum(s_sent, targets, num_segments=n),
+            jax.ops.segment_sum(w_sent, targets, num_segments=n),
+        )
+
+    # --- gather-inverted delivery ----------------------------------------
+    @jax.jit
+    def recv_gather(key, s, w):
+        return received_by_inversion(nbrs_inv, key, s, w)
+
+    # agreement: scalar verdicts on device (full 1M+ fetches through the
+    # tunnel cost minutes)
+    @jax.jit
+    def check(key, s, w):
+        a_s, a_w = recv_scatter(key, s, w)
+        b_s, b_w = recv_gather(key, s, w)
+        # ulp-scale disagreement only (summation order); values are O(1)
+        # and in-degrees are O(max_deg), so absolute tolerance is safe
+        close = jnp.all(jnp.abs(a_s - b_s) <= 1e-4) & jnp.all(
+            jnp.abs(a_w - b_w) <= 1e-4
+        )
+        sent_s = jnp.sum(jnp.where(valid, s, 0)) * 0.5
+        cons = jnp.abs(jnp.sum(b_s) - sent_s) / sent_s
+        return close, cons
+
+    close, cons = jax.device_get(check(key, s, w))
+    print(f"elementwise agreement (atol 1e-4): {bool(close)}")
+    print(f"delivered-mass relative drift    : {float(cons):.2e}")
+    assert bool(close), "inversion must reproduce the scatter delivery"
+
+    R = 64
+
+    def loop(recv):
+        @jax.jit
+        def run(key, s, w):
+            def body(i, sw):
+                s_, w_ = sw
+                k = jax.random.fold_in(key, i)
+                in_s, in_w = recv(k, s_, w_)
+                # fold the received mass back so the loop carries a data
+                # dependency (XLA must run every round)
+                return s_ * 0.5 + in_s, w_ * 0.5 + in_w
+            return jax.lax.fori_loop(0, R, body, (s, w))
+        return run
+
+    loop_scatter = loop(recv_scatter)
+    loop_gather = loop(recv_gather)
+
+    t_scatter = timed(lambda: sync(loop_scatter(key, s, w)[0])) / R
+    t_gather = timed(lambda: sync(loop_gather(key, s, w)[0])) / R
+    print(f"scatter delivery : {t_scatter*1e3:8.2f} ms/round")
+    print(f"gather inversion : {t_gather*1e3:8.2f} ms/round")
+    print(f"speedup          : {t_scatter/t_gather:8.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,34 @@ from __future__ import annotations
 import argparse
 import sys
 
+def _build_config(args, algo, fault_plan, jnp):
+    """argv -> RunConfig; raises ValueError on invalid combinations
+    (caught by main and reported as exit 2, the bad-input contract)."""
+    from gossipprotocol_tpu.engine import RunConfig
+
+    return RunConfig(
+        algorithm=algo,
+        dtype=jnp.float64 if args.x64 else jnp.float32,
+        seed=args.seed,
+        threshold=args.threshold,
+        eps=args.eps,
+        streak_target=args.streak,
+        keep_alive=not args.no_keep_alive,
+        semantics=args.semantics,
+        predicate=args.predicate,
+        tol=args.tol,
+        fanout=args.fanout,
+        delivery=args.delivery,
+        value_mode=args.value_mode,
+        max_rounds=args.max_rounds,
+        chunk_rounds=args.chunk_rounds,
+        seed_node=args.seed_node,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
+        fault_plan=fault_plan,
+    )
+
+
 _ALGO_ALIASES = {
     "gossip": "gossip",
     "push-sum": "push-sum",
@@ -68,6 +96,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "fanout-all diffusion variant that converges at "
                         "graph mixing time (required for hub-heavy graphs "
                         "like power-law at scale)")
+    p.add_argument("--delivery", choices=["scatter", "invert"],
+                   default="scatter",
+                   help="push-sum fanout-one delivery: segment_sum "
+                        "scatter-add, or the receiver-side gather inversion "
+                        "(single-chip, bounded-degree, no faults; "
+                        "trajectories agree to float accumulation order)")
     p.add_argument("--value-mode", choices=["scaled", "index"], default="scaled",
                    help="push-sum init: i/N (TPU-safe) or the reference's s_i=i")
     p.add_argument("--x64", action="store_true",
@@ -182,26 +216,22 @@ def main(argv=None) -> int:
 
     import jax.numpy as jnp
 
-    cfg = RunConfig(
-        algorithm=algo,
-        dtype=jnp.float64 if args.x64 else jnp.float32,
-        seed=args.seed,
-        threshold=args.threshold,
-        eps=args.eps,
-        streak_target=args.streak,
-        keep_alive=not args.no_keep_alive,
-        semantics=args.semantics,
-        predicate=args.predicate,
-        tol=args.tol,
-        fanout=args.fanout,
-        value_mode=args.value_mode,
-        max_rounds=args.max_rounds,
-        chunk_rounds=args.chunk_rounds,
-        seed_node=args.seed_node,
-        checkpoint_every=args.checkpoint_every,
-        checkpoint_dir=args.checkpoint_dir,
-        fault_plan=fault_plan,
-    )
+    try:
+        cfg = _build_config(args, algo, fault_plan, jnp)
+        if cfg.delivery == "invert":
+            # surface the engine's build-time preconditions as clean CLI
+            # input errors (exit 2), not tracebacks mid-run
+            from gossipprotocol_tpu.engine.driver import require_invertible
+
+            require_invertible(topo)
+            if args.devices > 1:
+                raise ValueError(
+                    "delivery='invert' is single-chip only — drop --devices "
+                    "or use delivery='scatter'"
+                )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
 
     state = None
     if args.resume:
@@ -239,6 +269,20 @@ def main(argv=None) -> int:
         if problems:
             print("checkpoint mismatch: " + "; ".join(problems), file=sys.stderr)
             return 2
+        if cfg.delivery == "invert":
+            # same build-time precondition the pre-flight block above
+            # surfaces for fresh runs: a faulted checkpoint's dead set is
+            # not component-closed, so the invert path would be inexact
+            from gossipprotocol_tpu.engine.driver import resume_allows_fast
+
+            if not resume_allows_fast(topo, state):
+                print(
+                    "delivery='invert' cannot resume this checkpoint: its "
+                    "dead set is not the birth exclusions (a faulted run) "
+                    "— resume with delivery='scatter'",
+                    file=sys.stderr,
+                )
+                return 2
 
     # append when resuming: the file keeps covering the whole logical run
     writer = (

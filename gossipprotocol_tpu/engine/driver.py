@@ -74,6 +74,10 @@ class RunConfig:
     fanout: str = "one"            # push-sum sender: "one" (reference's
                                    # single-target send, Program.fs:128) |
                                    # "all" (diffusion; see diffusion.py)
+    delivery: str = "scatter"      # push-sum fanout="one" delivery:
+                                   # "scatter" (segment_sum) | "invert"
+                                   # (receiver-side gather; see
+                                   # pushsum.received_by_inversion)
     value_mode: str = "scaled"     # push-sum init: "scaled" (i/N) | "index" (i)
     dtype: Any = jnp.float32
     max_rounds: int = 1_000_000
@@ -111,6 +115,22 @@ class RunConfig:
                 "single-target send IS the reference's accidental behavior "
                 "(Program.fs:128) that the diffusion variant replaces"
             )
+        if self.delivery not in ("scatter", "invert"):
+            raise ValueError("delivery must be 'scatter' or 'invert'")
+        if self.delivery == "invert":
+            if self.algorithm != "push-sum" or self.fanout != "one":
+                raise ValueError(
+                    "delivery='invert' applies to single-target push-sum "
+                    "only (gossip picks its inverted delivery automatically; "
+                    "diffusion walks every edge and has nothing to invert)"
+                )
+            if self.fault_plan:
+                raise ValueError(
+                    "delivery='invert' is exact only while no node can die "
+                    "mid-run (receivers recompute senders' draws without "
+                    "checking target liveness); drop the fault plan or use "
+                    "delivery='scatter'"
+                )
 
     def resolve_chunk_rounds(self, num_nodes: int) -> int:
         """Auto chunk size: target ~30 s of on-device work per chunk at an
@@ -276,6 +296,15 @@ def build_protocol(
                 targets_alive=targets_alive,
             )
         else:
+            if cfg.delivery == "invert":
+                # loud config errors, not silent fallbacks (SURVEY.md §5.6)
+                require_invertible(topo)
+                if not targets_alive:
+                    raise ValueError(
+                        "delivery='invert' is exact only while the dead set "
+                        "is component-closed (no fault plan, no resumed "
+                        "arbitrary dead set) — use delivery='scatter'"
+                    )
             core = partial(
                 pushsum_round,
                 n=n,
@@ -286,6 +315,7 @@ def build_protocol(
                 tol=cfg.tol,
                 all_alive=all_alive,
                 targets_alive=targets_alive,
+                delivery=cfg.delivery,
             )
         done_fn = pushsum_done
         extra_stats = None
@@ -301,6 +331,35 @@ def build_protocol(
             converged=state.converged | pad_dead,
         )
     return state, core, done_fn, extra_stats, (all_alive, targets_alive)
+
+
+def require_invertible(topo: Topology) -> None:
+    """delivery='invert' precondition: the dense table must be in use.
+
+    ``use_dense`` can be False for three distinct reasons; name the one
+    that actually applies so the error diagnoses the right knob.
+    """
+    import os
+
+    from gossipprotocol_tpu.protocols.sampling import (
+        DENSE_MAX_DEGREE, use_dense,
+    )
+
+    if use_dense(topo):
+        return
+    if topo.implicit_full:
+        why = ("the implicit complete graph has no neighbor table to "
+               "invert (neighbors are sampled, never materialized)")
+    elif os.environ.get("GOSSIP_TPU_DENSE", "1") == "0":
+        why = "GOSSIP_TPU_DENSE=0 disables the dense table"
+    else:
+        why = (f"max degree {int(topo.degree.max())} exceeds "
+               f"DENSE_MAX_DEGREE={DENSE_MAX_DEGREE} (hub graphs keep "
+               "the CSR path)")
+    raise ValueError(
+        f"delivery='invert' needs the dense neighbor table: {why} — "
+        "use delivery='scatter'"
+    )
 
 
 def gossip_inversion_enabled(topo: Topology, cfg: RunConfig) -> bool:
@@ -333,6 +392,11 @@ def device_arrays(topo: Topology, cfg: RunConfig):
         from gossipprotocol_tpu.protocols.diffusion import diffusion_edges
 
         return diffusion_edges(topo)
+    if cfg.algorithm == "push-sum" and cfg.delivery == "invert":
+        from gossipprotocol_tpu.protocols.gossip import inverted_dense
+
+        require_invertible(topo)  # same gate for direct callers
+        return inverted_dense(topo)
     if gossip_inversion_enabled(topo, cfg):
         from gossipprotocol_tpu.protocols.gossip import inverted_dense
 

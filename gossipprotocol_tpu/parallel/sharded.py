@@ -108,6 +108,14 @@ def _sharded_core(
             all_alive=all_alive,
             targets_alive=targets_alive,
         )
+    if cfg.delivery == "invert":
+        raise ValueError(
+            "delivery='invert' is single-chip only: the value gather needs "
+            "the full (s, w) vectors local (table ids are global), which "
+            "under shard_map would mean an all-gather per round — the "
+            "scatter path's psum_scatter moves strictly less. Use "
+            "delivery='scatter' on meshes."
+        )
     return partial(
         pushsum_round_core,
         n=n,

@@ -63,6 +63,7 @@ def pushsum_round_core(
     all_sum=jnp.sum,
     all_alive: bool = False,
     targets_alive: bool = False,
+    delivery: str = "scatter",
 ) -> PushSumState:
     """One synchronous round over the rows in ``gids``.
 
@@ -101,18 +102,37 @@ def pushsum_round_core(
       when |s/w − mean| <= tol for ``streak_target`` rounds.
     """
     key = jax.random.fold_in(base_key, state.round)
-    targets, valid = sample_neighbors(nbrs, n, key, gids)
 
-    if all_alive:
-        deliver = valid
-    elif targets_alive:
-        deliver = valid & state.alive
+    if delivery == "invert":
+        # receiver-side gather delivery (see received_by_inversion): no
+        # targets are materialized at all. Build-time validation pinned
+        # the legality window: dense table, component-closed dead set,
+        # single-chip rows (gids is None).
+        assert gids is None, "delivery='invert' is single-chip only"
+        valid = nbrs.degree > 0
+        deliver = valid if all_alive else (valid & state.alive)
+        s_sent = jnp.where(deliver, state.s * 0.5, jnp.zeros_like(state.s))
+        w_sent = jnp.where(deliver, state.w * 0.5, jnp.zeros_like(state.w))
+        in_s, in_w = received_by_inversion(nbrs, key, state.s, state.w)
+        if not all_alive:
+            # dead rows neighbor only dead rows (component closure), but
+            # their own gather output is garbage — pin them unchanged
+            zero = jnp.zeros_like(in_s)
+            in_s = jnp.where(state.alive, in_s, zero)
+            in_w = jnp.where(state.alive, in_w, zero)
     else:
-        deliver = valid & state.alive & alive_global[targets]
-    s_sent = jnp.where(deliver, state.s * 0.5, jnp.zeros_like(state.s))
-    w_sent = jnp.where(deliver, state.w * 0.5, jnp.zeros_like(state.w))
+        targets, valid = sample_neighbors(nbrs, n, key, gids)
 
-    in_s, in_w = scatter(s_sent, w_sent, targets)
+        if all_alive:
+            deliver = valid
+        elif targets_alive:
+            deliver = valid & state.alive
+        else:
+            deliver = valid & state.alive & alive_global[targets]
+        s_sent = jnp.where(deliver, state.s * 0.5, jnp.zeros_like(state.s))
+        w_sent = jnp.where(deliver, state.w * 0.5, jnp.zeros_like(state.w))
+
+        in_s, in_w = scatter(s_sent, w_sent, targets)
 
     s_new = state.s - s_sent + in_s
     w_new = state.w - w_sent + in_w
@@ -122,6 +142,49 @@ def pushsum_round_core(
         reference_semantics=reference_semantics,
         predicate=predicate, tol=tol, all_sum=all_sum, all_alive=all_alive,
     )
+
+
+def received_by_inversion(nbrs, key: jax.Array, s: jax.Array, w: jax.Array):
+    """Receiver-side ``(in_s, in_w)`` — no scatter, one static-index gather.
+
+    The push-sum analogue of gossip's :func:`~gossipprotocol_tpu.protocols.
+    gossip.hits_by_inversion`: the counter-based PRNG lets receiver ``i``
+    recompute each neighbor's draw, so the mass that lands on it is
+
+        in_s_i = Σ_k [ slot(table[i,k]) == rev[i,k] ] · s[table[i,k]] / 2
+
+    (``w`` alike). Unlike the gossip histogram no value-free shortcut
+    exists — ``(s, w)`` must move from sender rows to receiver rows — but
+    the movement becomes a **static-index** gather over the dense table
+    (stacked ``[rows, max_deg, 2]``, one pass for both streams) plus
+    elementwise compare/reduce, instead of two uniform-random
+    ``segment_sum`` scatter-adds. Static gathers are streaming reads;
+    random scatter-adds are the serialized read-modify-write "scatter
+    floor" (README, measured).
+
+    Exactness contract: reproduces the scatter delivery's multiset of
+    messages iff every sender with a valid draw delivers — the engine's
+    ``all_alive`` / ``targets_alive`` regimes (every neighbor of a row in
+    the table is alive by component-closure; a neighbor's degree is ≥ 1 by
+    edge symmetry). The float *summation order* differs from
+    ``segment_sum``'s, so trajectories agree to accumulation order, not
+    bitwise — delivery choice is therefore an explicit config
+    (``RunConfig.delivery``), never an on-device auto-switch like
+    gossip's (whose int histograms are bitwise-equal either way).
+
+    ``nbrs`` must be an :class:`~gossipprotocol_tpu.protocols.sampling.
+    InvertedDense`; rows beyond the caller's shard are its own concern —
+    this helper is single-chip (``table`` holds global ids, and gathering
+    ``s`` at them assumes the full state vector is local).
+    """
+    from gossipprotocol_tpu.protocols.sampling import recomputed_hits
+
+    hit = recomputed_hits(nbrs, key)
+    sv = jnp.stack([s, w], axis=-1)          # [n, 2]
+    gathered = sv[nbrs.table]                # [rows, maxd, 2] static gather
+    zero = jnp.asarray(0, s.dtype)
+    in_ = jnp.sum(jnp.where(hit[..., None], gathered, zero), axis=1) * 0.5
+    return in_[..., 0], in_[..., 1]
 
 
 def finish_pushsum_round(
@@ -190,13 +253,13 @@ def finish_pushsum_round(
     jax.jit,
     static_argnames=(
         "n", "eps", "streak_target", "reference_semantics", "predicate",
-        "tol", "all_alive", "targets_alive",
+        "tol", "all_alive", "targets_alive", "delivery",
     ),
     inline=True,
 )
 def pushsum_round(
     state: PushSumState,
-    nbrs,  # CSRNeighbors | DenseNeighbors | None (implicit full graph)
+    nbrs,  # CSRNeighbors | DenseNeighbors | InvertedDense | None (implicit full)
     base_key: jax.Array,
     *,
     n: int,
@@ -207,6 +270,7 @@ def pushsum_round(
     tol: float = 1e-4,
     all_alive: bool = False,
     targets_alive: bool = False,
+    delivery: str = "scatter",
 ) -> PushSumState:
     """Single-chip round. ``nbrs``/``base_key`` are runtime arguments so one
     compiled executable serves every same-shape topology and seed."""
@@ -232,6 +296,7 @@ def pushsum_round(
         tol=tol,
         all_alive=all_alive,
         targets_alive=targets_alive,
+        delivery=delivery,
     )
 
 

@@ -160,6 +160,28 @@ def _per_node_randint(key: jax.Array, gids: jax.Array, maxval: jax.Array) -> jax
     return (u % mx).astype(jnp.int32)
 
 
+def recomputed_hits(nbrs: InvertedDense, key: jax.Array) -> jax.Array:
+    """``hit[i, k]``: does neighbor ``table[i,k]``'s draw land on row i?
+
+    The shared core of both gather-inverted deliveries (gossip hit counts,
+    push-sum mass): recompute each neighbor's slot draw — the *same*
+    ``_per_node_randint(key, gid, max(deg, 1))`` convention
+    :func:`sample_neighbors` uses for the forward draw, which is the whole
+    exactness contract — and compare it against ``rev[i,k]``, the slot
+    that targets i. Elementwise over the static ``[rows, max_deg]``
+    tables; ``k >= degree[i]`` padding slots are masked off.
+    """
+    table = nbrs.table
+    rows, maxd = table.shape
+    slot = _per_node_randint(
+        key, table.reshape(-1),
+        jnp.maximum(nbrs.deg_nbr.reshape(-1), 1).astype(jnp.uint32),
+    ).reshape(rows, maxd)
+    return (slot == nbrs.rev.astype(jnp.int32)) & (
+        jnp.arange(maxd, dtype=jnp.int32)[None, :] < nbrs.degree[:, None]
+    )
+
+
 def sample_neighbors(
     nbrs,
     n: int,

@@ -29,6 +29,10 @@ _STATE_TYPES = {"GossipState": GossipState, "PushSumState": PushSumState}
 TRAJECTORY_FIELDS = (
     "algorithm", "seed", "semantics", "threshold", "eps", "streak_target",
     "keep_alive", "predicate", "tol", "value_mode", "dtype",
+    # sender/delivery variants change the trajectory too: fanout="all" is a
+    # different protocol; delivery="invert" sums received mass in a
+    # different float order than the scatter (both docstrings say so)
+    "fanout", "delivery",
 )
 
 

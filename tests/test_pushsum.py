@@ -177,3 +177,104 @@ def test_fault_preserves_alive_mass():
     np.testing.assert_allclose(
         np.asarray(state.s)[dead], np.asarray(pushsum_init(32).s)[dead], rtol=1e-6
     )
+
+
+# --- delivery="invert": receiver-side gather delivery ---------------------
+
+def _delivery_steps(topo, delivery, seed=0, **cfg_kw):
+    """Engine-built round fn honoring RunConfig validation + fast paths."""
+    from gossipprotocol_tpu import RunConfig
+    from gossipprotocol_tpu.engine.driver import build_protocol, device_arrays
+
+    cfg = RunConfig(algorithm="push-sum", seed=seed, delivery=delivery,
+                    **cfg_kw)
+    state, core, _, _, _ = build_protocol(topo, cfg)
+    nbrs = device_arrays(topo, cfg)
+    key = jax.random.key(seed)
+    return state, jax.jit(lambda s: core(s, nbrs, key))
+
+
+def test_inverted_delivery_matches_scatter_trajectory():
+    """Same multiset of delivered messages -> same trajectory up to float
+    accumulation order, and mass conserved exactly as well as scatter's."""
+    for name, n in [("imp3D", 27), ("erdos_renyi", 96), ("line", 40)]:
+        topo = build_topology(name, n, seed=3)
+        st_s, step_s = _delivery_steps(topo, "scatter", seed=3)
+        st_i, step_i = _delivery_steps(topo, "invert", seed=3)
+        s0, w0 = mass(st_i)
+        for r in range(60):
+            st_s = step_s(st_s)
+            st_i = step_i(st_i)
+            np.testing.assert_allclose(
+                np.asarray(st_i.s), np.asarray(st_s.s), atol=1e-5,
+                err_msg=f"{name} round {r}: s diverged past float order")
+            np.testing.assert_allclose(
+                np.asarray(st_i.w), np.asarray(st_s.w), atol=1e-5,
+                err_msg=f"{name} round {r}: w diverged past float order")
+        s1, w1 = mass(st_i)
+        np.testing.assert_allclose(float(s1), float(s0), rtol=1e-5)
+        np.testing.assert_allclose(float(w1), float(w0), rtol=1e-5)
+
+
+def test_inverted_delivery_engine_converges():
+    from gossipprotocol_tpu import RunConfig, run_simulation
+
+    topo = build_topology("erdos_renyi", 256, seed=5)
+    res = run_simulation(topo, RunConfig(
+        algorithm="push-sum", seed=5, delivery="invert",
+        predicate="global", tol=1e-4,
+    ))
+    assert res.converged
+    assert res.estimate_error < 2e-4
+
+
+def test_inverted_delivery_respects_birth_exclusions():
+    """Sparse ER is born with isolated nodes (dead rows): the inverted path
+    must leave them untouched and still converge the majority."""
+    from gossipprotocol_tpu import RunConfig, run_simulation
+
+    # low degree -> isolated nodes virtually guaranteed at this size
+    topo = build_topology("erdos_renyi", 512, avg_degree=3.0, seed=1)
+    birth = topo.birth_alive()
+    assert birth is not None and not birth.all(), "need dead-at-birth rows"
+    res = run_simulation(topo, RunConfig(
+        algorithm="push-sum", seed=1, delivery="invert",
+        predicate="global", tol=1e-4,
+    ))
+    assert res.converged
+    st = res.final_state
+    dead = ~np.asarray(st.alive)
+    init = pushsum_init(topo.num_nodes)
+    np.testing.assert_array_equal(
+        np.asarray(st.s)[dead], np.asarray(init.s)[dead])
+
+
+def test_inverted_delivery_config_errors():
+    import pytest
+
+    from gossipprotocol_tpu import RunConfig
+    from gossipprotocol_tpu.engine.driver import build_protocol
+
+    with pytest.raises(ValueError, match="single-target push-sum"):
+        RunConfig(algorithm="gossip", delivery="invert")
+    with pytest.raises(ValueError, match="single-target push-sum"):
+        RunConfig(algorithm="push-sum", fanout="all", delivery="invert")
+    with pytest.raises(ValueError, match="no node can die"):
+        RunConfig(algorithm="push-sum", delivery="invert",
+                  fault_plan={10: [1, 2]})
+    # hub graphs keep the CSR path: no dense table to invert
+    hub = build_topology("power_law", 512, m=4, seed=0)
+    with pytest.raises(ValueError, match="dense neighbor table"):
+        build_protocol(hub, RunConfig(algorithm="push-sum", delivery="invert"))
+
+
+def test_inverted_delivery_sharded_rejected(cpu_devices):
+    import pytest
+
+    from gossipprotocol_tpu import RunConfig
+    from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+    topo = build_topology("imp3D", 64)
+    cfg = RunConfig(algorithm="push-sum", delivery="invert")
+    with pytest.raises(ValueError, match="single-chip only"):
+        run_simulation_sharded(topo, cfg, mesh=make_mesh(devices=cpu_devices[:8]))
