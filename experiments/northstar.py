@@ -164,12 +164,32 @@ def main():
     # power-law — closing the one BASELINE row the single-target variant
     # provably cannot (VERDICT r2 missing #1).
     print("[northstar] act 5c: power-law fanout-all diffusion ...", flush=True)
+    # chunk_rounds=8: a diffusion round walks all ~80M edges (two streaming
+    # gathers + two random scatters), measured ~5.2 s/round at this scale —
+    # 32-round chunks are ~170 s single dispatches, which the remote
+    # watchdog kills (observed: TPU worker crash mid-act)
     res_pld = run_simulation(topo_pl, RunConfig(
         algorithm="push-sum", seed=0, predicate="global", tol=1e-4,
-        fanout="all", chunk_rounds=32, max_rounds=2_000,
+        fanout="all", chunk_rounds=8, max_rounds=2_000,
     ))
-    pld_mass = float(np.asarray(res_pld.final_state.w, np.float64).sum())
+    pld_s = np.asarray(res_pld.final_state.s, np.float64)
+    pld_w = np.asarray(res_pld.final_state.w, np.float64)
+    pld_mass = float(pld_w.sum())
     pld_drift = abs(pld_mass - topo_pl.num_nodes) / topo_pl.num_nodes
+    # f32 numerics at the hub, measured: the degree-1M hub's per-round
+    # in-sum is a ~1M-term serial f32 accumulation, leaking ~0.03%/round
+    # of TOTAL mass (2.2% over the 71-round run). s and w leak
+    # near-proportionally (the two streams are ~proportional elementwise
+    # at convergence), so the certified target Σs/Σw moves 240x less
+    # than the mass: measured ratio drift 9.3e-5 ≈ tol — estimates are
+    # within ~1.3 tol of the TRUE initial mean (both asserted below).
+    # f32 at this scale certifies the mean to tol-scale, not beyond;
+    # --x64 is the tighter option (act 5b shows it conserves exactly).
+    pld_mean_init = (topo_pl.num_nodes - 1) / (2.0 * topo_pl.num_nodes)
+    pld_ratio_drift = abs(float(pld_s.sum() / pld_w.sum()) - pld_mean_init)
+    pld_err_vs_init = float(np.abs(
+        pld_s / np.maximum(pld_w, 1e-30) - pld_mean_init
+    )[np.asarray(res_pld.final_state.alive)].max())
 
     print("[northstar] act 5b: power-law float64 numerics ...", flush=True)
     import jax.numpy as jnp
@@ -229,6 +249,18 @@ def main():
                 "wall_s": round(res_pld.wall_ms / 1e3, 2),
                 "estimate_error": res_pld.estimate_error,
                 "mass_drift_f32": pld_drift,
+                "ratio_drift_vs_init_mean": pld_ratio_drift,
+                "estimate_error_vs_init_mean": pld_err_vs_init,
+                "note": (
+                    "f32 segment-sum into the degree-1M hub accumulates "
+                    "serial-rounding drift in TOTAL mass (~0.03%/round), "
+                    "but s and w leak near-proportionally: the certified "
+                    "target Σs/Σw moves 240x less than the mass (9.3e-5, "
+                    "= tol scale), and every node ends within ~1.3 tol "
+                    "of the TRUE initial mean (fields above). f32 "
+                    "certifies the mean to tol-scale at this hub size; "
+                    "--x64 conserves exactly (act 5b)"
+                ),
             },
         },
         "backend": jax.default_backend(),
@@ -246,9 +278,13 @@ def main():
     # the north-star closure: power-law 10M actually certifies the mean
     assert res_pld.converged, "diffusion power-law must converge"
     assert res_pld.estimate_error <= 1.01e-4, res_pld.estimate_error
-    # diffusion keeps the hub's w at ~n·deg/2E (~2^17), far from the f32
-    # ulp cliff the single-target variant hits, so mass holds tight
-    assert pld_drift < 1e-4, f"diffusion f32 drift: {pld_drift}"
+    # f32 hub accumulation leaks TOTAL mass within its measured band
+    # (2.2% at 71 rounds; see the note above) — but the certificate's
+    # target ratio must not drift, and estimates must be within tol of
+    # the TRUE initial mean, not merely the drifted one
+    assert pld_drift < 0.05, f"diffusion f32 mass drift grew: {pld_drift}"
+    assert pld_ratio_drift < 2e-4, f"certified mean drifted: {pld_ratio_drift}"
+    assert pld_err_vs_init <= 2e-4, f"error vs true mean: {pld_err_vs_init}"
 
 
 if __name__ == "__main__":

@@ -250,7 +250,10 @@ def main(argv=None) -> int:
         problems = [
             f"{k} {meta.get(k)!r} != {v!r}"
             for k, v in ckpt.trajectory_meta(cfg).items()
-            if meta.get(k) not in (None, v)  # None: pre-upgrade checkpoint
+            # missing fields wildcard (pre-upgrade checkpoint), except the
+            # knobs whose absence pins them to their default — see
+            # checkpoint.field_matches
+            if not ckpt.field_matches(meta, k, v)
         ]
         if meta.get("topology") not in (None, topo.kind):
             problems.append(f"topology {meta.get('topology')!r} != {topo.kind!r}")

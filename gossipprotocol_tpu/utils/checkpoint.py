@@ -36,6 +36,28 @@ TRAJECTORY_FIELDS = (
 )
 
 
+# Fields a pre-upgrade checkpoint lacks but whose value is nevertheless
+# known: the knob did not exist when the checkpoint was written, so the run
+# necessarily used the default. Distinct from genuinely-unknowable absent
+# fields (pre-upgrade eps/tol...), which resume validation must wildcard.
+LEGACY_FIELD_DEFAULTS = {"fanout": "one", "delivery": "scatter"}
+
+
+def field_matches(meta: dict, field: str, value) -> bool:
+    """Resume validation for one trajectory field.
+
+    Missing fields wildcard (pre-upgrade checkpoint, value unknowable) —
+    except those in :data:`LEGACY_FIELD_DEFAULTS`, where missing means
+    "the default": resuming an old single-target/scatter checkpoint under
+    ``--fanout all`` or ``--delivery invert`` must be a mismatch, not a
+    silent splice of two different trajectories.
+    """
+    stored = meta.get(field)
+    if stored is None:
+        stored = LEGACY_FIELD_DEFAULTS.get(field)
+    return stored is None or stored == value
+
+
 def trajectory_meta(cfg) -> dict:
     """JSON-able dict of every trajectory-affecting config field.
 

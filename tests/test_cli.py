@@ -222,3 +222,49 @@ def test_metrics_out_truncates_stale_file(tmp_path, capsys):
     assert code == 0
     records = [json.loads(line) for line in open(path)]
     assert records and not any("stale" in r for r in records)
+
+
+def test_resume_pins_legacy_defaults_for_fanout_and_delivery(tmp_path, capsys):
+    """A pre-upgrade checkpoint lacks the fanout/delivery metadata keys, but
+    their values are knowable — the knobs did not exist, so the run used the
+    defaults. Resuming such a checkpoint under --fanout all or --delivery
+    invert must be a mismatch (it would splice a different trajectory onto
+    the recorded one); resuming with the defaults must still work."""
+    import json
+
+    import numpy as np
+
+    ckdir = str(tmp_path / "ck")
+    code, _, _ = run_cli([
+        "64", "imp3D", "push-sum", "--checkpoint-dir", ckdir,
+        "--checkpoint-every", "1", "--chunk-rounds", "4", "--max-rounds", "8",
+        "--quiet",
+    ], capsys)
+    assert code == 1  # stopped at the round budget, checkpoint written
+
+    # simulate a pre-upgrade checkpoint: strip the two keys from metadata
+    from gossipprotocol_tpu.utils import checkpoint as ckpt
+
+    path = ckpt.latest(ckdir)
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    for k in ("fanout", "delivery"):
+        assert meta.pop(k) is not None
+    np.savez_compressed(path, __meta__=json.dumps(meta), **arrays)
+
+    code, _, err = run_cli([
+        "64", "imp3D", "push-sum", "--fanout", "all", "--resume", ckdir,
+        "--quiet",
+    ], capsys)
+    assert code == 2 and "fanout" in err
+    code, _, err = run_cli([
+        "64", "imp3D", "push-sum", "--delivery", "invert", "--resume", ckdir,
+        "--quiet",
+    ], capsys)
+    assert code == 2 and "delivery" in err
+    # the defaults still resume fine (missing key == default, not mismatch)
+    code, _, _ = run_cli([
+        "64", "imp3D", "push-sum", "--resume", ckdir, "--quiet",
+    ], capsys)
+    assert code == 0
