@@ -92,7 +92,9 @@ def min_bytes_per_round(topo, algorithm: str, fanout: str = "one",
     return e * (8 + 16) + n * (4 + 24 + 8 + 2 + 1 + 8)
 
 
-def time_protocol_round(topo, cfg: RunConfig, rounds: int) -> float:
+def time_protocol_round(
+    topo, cfg: RunConfig, rounds: int, repeats: int = 5
+) -> float:
     """Seconds per round of the real chunk runner (convergence disabled so
     the loop always runs the full ``rounds``), min-of-repeats, warmed."""
     state0, core, done_fn, extra, _ = build_protocol(topo, cfg)
@@ -119,7 +121,7 @@ def time_protocol_round(topo, cfg: RunConfig, rounds: int) -> float:
         out, _ = compiled(st, nbrs, key, jnp.int32(rounds))
         return sync(out[0])  # counts (gossip) / s (push-sum)
 
-    return timed(run) / rounds
+    return timed(run, repeats) / rounds
 
 
 def roofline(nodes: int, rounds: int, hbm_gbps: float) -> None:
@@ -154,9 +156,18 @@ def roofline(nodes: int, rounds: int, hbm_gbps: float) -> None:
         prev = os.environ.get("GOSSIP_TPU_INVERT")
         if invert_env is not None:
             os.environ["GOSSIP_TPU_INVERT"] = invert_env
+        # diffusion walks every edge (~8N): at 10M that is ~5.4 s/round,
+        # and a >2-minute single dispatch trips the remote watchdog
+        # (observed: TPU worker crash) — cap this row's trip count
+        big_diffusion = fanout == "all" and nodes > 2_000_000
+        r = min(rounds, 8) if big_diffusion else rounds
         try:
             topo = build_topology(kind, nodes, seed=0)
-            t = time_protocol_round(topo, cfg, rounds)
+            # 2 repeats: each 8-round diffusion dispatch is ~43 s at 10M;
+            # min-of-5 would push the row alone past 5 minutes
+            t = time_protocol_round(
+                topo, cfg, r, repeats=2 if big_diffusion else 5
+            )
         finally:
             if invert_env is not None:
                 if prev is None:

@@ -16,8 +16,19 @@ itself:
     in_s_i = Σ_k [ slot(nbr_k) == rev[i,k] ] · s[nbr_k] / 2    (w alike)
 
 One [N, max_deg, 2] gather at fixed indices + elementwise compare/reduce
-replaces both segment_sums. Static-index gathers are prefetchable
-streaming reads — the bet is that they beat random-write scatters.
+replaces both segment_sums. The bet was that gathers (no write
+conflicts) beat random-write scatters.
+
+MEASURED OUTCOME (TPU v5e, 1M Erdős–Rényi, max_deg 24): the bet LOSES
+9x — 137.7 (invert) vs 15.1 (scatter) ms/round. Decomposition: the
+draw recompute + compare alone is 3.9 ms (the part that made gossip's
+inversion win 3.6x), but the [N, max_deg] random-index value gather is
+~135 ms stacked — and two flat [N, max_deg] gathers are 2.6x worse
+(370 ms), so stacking was right, the gather itself is the wall. XLA
+lowers random-index gathers as badly as random scatters on this
+hardware; inversion pays exactly when the receiver reconstructs the
+message without reading sender values. Kept in the engine as a
+validated negative (`--delivery invert`); scatter stays the default.
 
 Exactness: the delivered multiset is identical to the scatter path's
 whenever every sender with a live target delivers — the engine's

@@ -101,7 +101,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="push-sum fanout-one delivery: segment_sum "
                         "scatter-add, or the receiver-side gather inversion "
                         "(single-chip, bounded-degree, no faults; "
-                        "trajectories agree to float accumulation order)")
+                        "trajectories agree to float accumulation order; "
+                        "measured 9x slower on TPU v5e — a validated "
+                        "negative result, see README)")
     p.add_argument("--value-mode", choices=["scaled", "index"], default="scaled",
                    help="push-sum init: i/N (TPU-safe) or the reference's s_i=i")
     p.add_argument("--x64", action="store_true",
@@ -248,7 +250,9 @@ def main(argv=None) -> int:
         # trajectory_meta(cfg) is the same mapping save() embedded, so the
         # two sides can never drift.
         problems = [
-            f"{k} {meta.get(k)!r} != {v!r}"
+            # report the value the comparison actually used: for a
+            # missing legacy field that is its pinned default, not None
+            f"{k} {meta.get(k, ckpt.LEGACY_FIELD_DEFAULTS.get(k))!r} != {v!r}"
             for k, v in ckpt.trajectory_meta(cfg).items()
             # missing fields wildcard (pre-upgrade checkpoint), except the
             # knobs whose absence pins them to their default — see

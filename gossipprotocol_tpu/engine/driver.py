@@ -76,8 +76,10 @@ class RunConfig:
                                    # "all" (diffusion; see diffusion.py)
     delivery: str = "scatter"      # push-sum fanout="one" delivery:
                                    # "scatter" (segment_sum) | "invert"
-                                   # (receiver-side gather; see
-                                   # pushsum.received_by_inversion)
+                                   # (receiver-side gather; measured 9x
+                                   # SLOWER on TPU v5e — kept as a
+                                   # validated negative result, see
+                                   # README + pushsum.received_by_inversion)
     value_mode: str = "scaled"     # push-sum init: "scaled" (i/N) | "index" (i)
     dtype: Any = jnp.float32
     max_rounds: int = 1_000_000

@@ -158,9 +158,17 @@ def received_by_inversion(nbrs, key: jax.Array, s: jax.Array, w: jax.Array):
     the movement becomes a **static-index** gather over the dense table
     (stacked ``[rows, max_deg, 2]``, one pass for both streams) plus
     elementwise compare/reduce, instead of two uniform-random
-    ``segment_sum`` scatter-adds. Static gathers are streaming reads;
-    random scatter-adds are the serialized read-modify-write "scatter
-    floor" (README, measured).
+    ``segment_sum`` scatter-adds. The bet was that gathers (no write
+    conflicts) beat random scatters.
+
+    Measured outcome (TPU v5e, 1M Erdős–Rényi): the bet LOSES 9x —
+    137.7 vs 15.1 ms/round. The draw recompute costs 3.9 ms (the part
+    that made gossip's inversion win), but XLA lowers the random-index
+    value gather to ~135 ms (two flat gathers: 2.6x worse still): on
+    this hardware a random gather costs what a random scatter does, so
+    inversion only pays when no sender values are read at all (gossip's
+    hit counts). Kept as a validated negative result;
+    ``delivery="scatter"`` is the default (README "Performance").
 
     Exactness contract: reproduces the scatter delivery's multiset of
     messages iff every sender with a valid draw delivers — the engine's
