@@ -3,56 +3,118 @@ runs with golden round counts").
 
 Gossip trajectories are integer + counter-based threefry, so the round
 count is exact and backend/sharding-invariant — pinned hard everywhere.
-Push-sum is float32; its trajectory is deterministic on a given backend,
-so it is pinned **exactly on the CPU backend the suite runs on** (any
-drift — a changed reduction order, an XLA upgrade — trips the wire), with
-a ±20/25 % band as the cross-backend fallback (TPU rounding may differ).
+Push-sum is float32; its trajectory is deterministic on a given backend
+but reduction order differs across backends, so it is pinned **exactly
+per backend** (CPU — what the suite runs on — and TPU v5e, recorded on
+the real chip), with a wide −50 %/+25 % band around the CPU reference
+as the fallback for any other backend (both recorded tables fit it).
+
+The suite's conftest pins every computation to CPU, so the TPU table is
+exercised by explicit opt-in on a TPU host:
+
+    GOLDEN_BACKEND=tpu python -m pytest tests/test_golden.py
+
+which scopes the runs to that platform's first device and selects its
+exact table.
+
+The per-backend gap is real signal, not noise: on power_law@128 the TPU
+needs 343 rounds where the CPU needs 649 — the delta predicate's
+eps-streak is chaotic under reduction-order changes (README
+"Convergence-predicate soundness"), and the old cross-backend band
+(±25 %) would have *failed* there. An exact table per backend catches
+on-chip drift (an XLA upgrade changing scatter association, a changed
+reduction order) that a band never could.
 
 If a deliberate change to sampling or protocol semantics moves these
 numbers, update the table in the same commit and say why.
 """
 
+import contextlib
+import os
+
 import pytest
 
 from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
 
-# (topology, n) -> (gossip_rounds_exact, pushsum_rounds_cpu_exact)
-GOLDEN = {
-    ("line", 64): (113, 193),
-    ("full", 128): (28, 87),
-    ("3D", 64): (29, 149),
-    ("imp3D", 64): (25, 124),
-    ("erdos_renyi", 128): (49, 111),
-    ("power_law", 128): (575, 649),
+# (topology, n) -> gossip_rounds (exact on EVERY backend)
+GOLDEN_GOSSIP = {
+    ("line", 64): 113,
+    ("full", 128): 28,
+    ("3D", 64): 29,
+    ("imp3D", 64): 25,
+    ("erdos_renyi", 128): 49,
+    ("power_law", 128): 575,
+}
+
+# backend -> {(topology, n) -> pushsum_rounds} (exact per backend)
+GOLDEN_PUSHSUM = {
+    "cpu": {
+        ("line", 64): 193,
+        ("full", 128): 87,
+        ("3D", 64): 149,
+        ("imp3D", 64): 124,
+        ("erdos_renyi", 128): 111,
+        ("power_law", 128): 649,
+    },
+    # recorded on a real TPU v5e (axon); gossip rounds verified identical
+    "tpu": {
+        ("line", 64): 193,
+        ("full", 128): 87,
+        ("3D", 64): 149,
+        ("imp3D", 64): 122,
+        ("erdos_renyi", 128): 114,
+        ("power_law", 128): 343,
+    },
 }
 
 
-def _on_cpu() -> bool:
+def _backend_ctx():
+    """(platform name, context manager scoping runs to that platform).
+
+    ``GOLDEN_BACKEND=<platform>`` opts out of the conftest CPU pin and
+    runs on that platform's first device — how the TPU table is
+    exercised on a TPU host. Otherwise the platform is whatever the
+    suite pinned: ``jax_default_device`` may hold a Device *or* a
+    platform string (jax accepts both), or be unset.
+    """
     import jax
 
-    return jax.config.jax_default_device.platform == "cpu"
+    forced = os.environ.get("GOLDEN_BACKEND")
+    if forced:
+        return forced, jax.default_device(jax.devices(forced)[0])
+    dev = jax.config.jax_default_device
+    if dev is None:
+        return jax.default_backend(), contextlib.nullcontext()
+    return getattr(dev, "platform", str(dev)), contextlib.nullcontext()
 
 
-@pytest.mark.parametrize("key", sorted(GOLDEN), ids=lambda k: f"{k[0]}-{k[1]}")
+@pytest.mark.parametrize(
+    "key", sorted(GOLDEN_GOSSIP), ids=lambda k: f"{k[0]}-{k[1]}"
+)
 def test_golden_rounds(key):
     name, n = key
-    gossip_gold, pushsum_gold = GOLDEN[key]
+    backend, ctx = _backend_ctx()
     topo = build_topology(name, n, seed=11)
 
-    g = run_simulation(topo, RunConfig(algorithm="gossip", seed=42))
+    with ctx:
+        g = run_simulation(topo, RunConfig(algorithm="gossip", seed=42))
+        p = run_simulation(topo, RunConfig(algorithm="push-sum", seed=42))
+
     assert g.converged
-    assert g.rounds == gossip_gold, (
-        f"gossip {name}@{n}: {g.rounds} != golden {gossip_gold}"
+    assert g.rounds == GOLDEN_GOSSIP[key], (
+        f"gossip {name}@{n}: {g.rounds} != golden {GOLDEN_GOSSIP[key]}"
     )
 
-    p = run_simulation(topo, RunConfig(algorithm="push-sum", seed=42))
     assert p.converged
-    if _on_cpu():
-        assert p.rounds == pushsum_gold, (
-            f"push-sum {name}@{n}: {p.rounds} != cpu golden {pushsum_gold}"
+    table = GOLDEN_PUSHSUM.get(backend)
+    if table is not None:
+        assert p.rounds == table[key], (
+            f"push-sum {name}@{n} on {backend}: "
+            f"{p.rounds} != golden {table[key]}"
         )
-    else:
-        lo, hi = int(pushsum_gold * 0.8), int(pushsum_gold * 1.25)
+    else:  # unknown backend: wide band, both recorded tables inside it
+        ref = GOLDEN_PUSHSUM["cpu"][key]
+        lo, hi = int(ref * 0.5), int(ref * 1.25)
         assert lo <= p.rounds <= hi, (
             f"push-sum {name}@{n}: {p.rounds} outside [{lo}, {hi}]"
         )
