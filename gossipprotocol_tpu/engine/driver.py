@@ -134,20 +134,36 @@ class RunConfig:
                     "delivery='scatter'"
                 )
 
-    def resolve_chunk_rounds(self, num_nodes: int) -> int:
-        """Auto chunk size: target ~30 s of on-device work per chunk at an
-        observed ~100 ns/node/round, clamped to [4, 4096].
+    def resolve_chunk_rounds(
+        self, num_nodes: int, num_edges: Optional[int] = None
+    ) -> int:
+        """Auto chunk size: target ~30 s of on-device work per chunk,
+        clamped to [4, 4096] — one chunk must stay well under the remote
+        watchdog's single-dispatch budget (~2 min; exceeding it crashes
+        the TPU worker, observed twice) while amortizing ~100 ms tunnel
+        dispatch overhead.
 
-        float64 divides the budget by 16: TPU f64 is software-emulated
-        (~10-30x slower), and a multi-minute on-device chunk trips the
-        remote-execution watchdog (observed as a TPU worker crash).
+        The per-round cost model uses measured v5e worst-case rates
+        (README roofline): ~100 ns/node for the node-sharded senders
+        (covers the scatter paths with margin), plus ~65 ns/edge for
+        fanout-all diffusion, whose rounds walk every edge — at 10M
+        power-law that is ~5.4 s/round, so a node-count-only estimate
+        would pick ~170 s chunks and kill the worker. float64 divides
+        the budget by 16 (TPU f64 is software-emulated, ~10-30x slower).
         """
         if self.chunk_rounds is not None:
             return self.chunk_rounds
-        est = int(3e8 / max(num_nodes, 1))
+        per_round_s = max(num_nodes, 1) * 100e-9
+        if self.algorithm == "push-sum" and self.fanout == "all":
+            per_round_s += (num_edges or 0) * 65e-9
         if jnp.dtype(self.dtype) == jnp.float64:
-            est //= 16
-        return max(4, min(4096, est))
+            per_round_s *= 16
+        # the >=4 floor only amortizes dispatch overhead; when single
+        # rounds are already tens of seconds (f64 diffusion at 10M), a
+        # forced 4-round chunk would itself bust the watchdog — drop to
+        # single-round chunks instead
+        lo = 1 if per_round_s > 15.0 else 4
+        return max(lo, min(4096, int(30.0 / per_round_s)))
 
 
 @dataclasses.dataclass
@@ -486,7 +502,10 @@ def _drive(
     from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
 
     fault_plan = {int(k): v for k, v in (cfg.fault_plan or {}).items()}
-    chunk_rounds = cfg.resolve_chunk_rounds(topo.num_nodes)
+    chunk_rounds = cfg.resolve_chunk_rounds(
+        topo.num_nodes,
+        None if topo.implicit_full else int(topo.indices.size),
+    )
     metrics: List[dict] = []
     checkpoints: List[str] = []
     chunk_i = 0

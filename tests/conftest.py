@@ -14,7 +14,14 @@ local compiles, no tunnel round-trips.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")  # effective when run standalone
+# effective when run standalone; GOLDEN_BACKEND (tests/test_golden.py's
+# opt-in to pin golden rounds on real hardware) must keep its platform
+# visible, else jax.devices(<backend>) raises on a stock (no
+# sitecustomize) host where this setdefault actually takes effect
+_golden = os.environ.get("GOLDEN_BACKEND")
+os.environ.setdefault(
+    "JAX_PLATFORMS", f"{_golden},cpu" if _golden else "cpu"
+)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -234,7 +234,9 @@ def test_auto_chunk_shrinks_for_float64():
     f64 = RunConfig(algorithm="push-sum", dtype=jnp.float64)
     n = 10_000_000
     assert f64.resolve_chunk_rounds(n) * 16 <= f32.resolve_chunk_rounds(n) + 64
-    assert f64.resolve_chunk_rounds(n) >= 4
+    # the floor drops to 1 when single rounds are already tens of seconds
+    # (the >=4 dispatch-amortization floor would itself bust the watchdog)
+    assert f64.resolve_chunk_rounds(n) >= 1
 
 
 def test_metrics_callback_stream():
@@ -327,3 +329,33 @@ def test_estimate_error_ignores_stranded_dead_mass():
     res = run_simulation(topo, cfg)
     assert res.converged
     assert res.estimate_error < 1e-3
+
+
+def test_auto_chunk_accounts_for_diffusion_edges():
+    """Fanout-all rounds walk every edge (~65 ns/edge measured at 10M
+    power-law, ~5.4 s/round): a node-count-only estimate would pick ~170 s
+    chunks and crash the TPU worker (remote watchdog; observed). The
+    estimator must keep one diffusion chunk's on-device time bounded."""
+    one = RunConfig(algorithm="push-sum")
+    diff = RunConfig(algorithm="push-sum", fanout="all")
+    n, e = 10_000_000, 80_000_000
+    # single-target ignores edges; diffusion shrinks far below it
+    assert one.resolve_chunk_rounds(n, e) == one.resolve_chunk_rounds(n)
+    assert diff.resolve_chunk_rounds(n, e) * 5.4 <= 120, (
+        "a diffusion chunk at 10M power-law must stay under the watchdog")
+    assert diff.resolve_chunk_rounds(n, e) >= 4
+    # explicit chunk_rounds always wins
+    assert RunConfig(algorithm="push-sum", fanout="all",
+                     chunk_rounds=8).resolve_chunk_rounds(n, e) == 8
+
+
+def test_auto_chunk_f64_diffusion_stays_under_watchdog():
+    """f64 diffusion at 10M power-law: per-round is ~100 s (16x emulation
+    on ~6 s f32 rounds); the old >=4-round floor would force ~400 s
+    dispatches — the estimator must drop to single-round chunks."""
+    import jax.numpy as jnp
+
+    cfg = RunConfig(algorithm="push-sum", fanout="all", dtype=jnp.float64)
+    n, e = 10_000_000, 80_000_000
+    chunks = cfg.resolve_chunk_rounds(n, e)
+    assert 1 <= chunks <= 2, chunks
