@@ -138,10 +138,10 @@ class RunConfig:
         self, num_nodes: int, num_edges: Optional[int] = None
     ) -> int:
         """Auto chunk size: target ~30 s of on-device work per chunk,
-        clamped to [4, 4096] — one chunk must stay well under the remote
-        watchdog's single-dispatch budget (~2 min; exceeding it crashes
-        the TPU worker, observed twice) while amortizing ~100 ms tunnel
-        dispatch overhead.
+        clamped to [4, 4096] — or [1, 4096] when a single round already
+        exceeds ~15 s, since then even the 4-round dispatch-amortization
+        floor would bust the remote watchdog's single-dispatch budget
+        (~2 min; exceeding it crashes the TPU worker, observed twice).
 
         The per-round cost model uses measured v5e worst-case rates
         (README roofline): ~100 ns/node for the node-sharded senders

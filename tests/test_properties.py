@@ -268,3 +268,71 @@ def test_checkpoint_roundtrip_preserves_trajectory(g, seed, tmp_path_factory):
     np.testing.assert_array_equal(
         np.asarray(resumed.final_state.s), np.asarray(full.final_state.s)
     )
+
+
+@given(
+    g=random_graph(max_nodes=32),
+    seed=st.integers(0, 2**31 - 1),
+    devices=st.sampled_from([2, 4, 8]),
+)
+@example(g=STAR_COUNTEREXAMPLE, seed=2, devices=2)
+@settings(**SETTINGS)
+def test_sharded_diffusion_ulp_equal_at_equal_rounds(
+    g, seed, devices, cpu_devices
+):
+    """Fanout-all diffusion's sharding invariance, fuzzed the same way as
+    the single-target contract above: no draws at all, so the only
+    divergence between layouts is the per-device partial segment_sum +
+    psum_scatter association vs one global segment_sum — float
+    accumulation order, ~ulp per round. Hub-and-spoke shapes (the star
+    example) are the interesting case: every edge of the hub's in-sum
+    crosses a shard boundary."""
+    from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+    n, edges = g
+    topo = csr_from_edges(n, edges, kind="fuzz")
+    rounds = 48
+    cfg = RunConfig(algorithm="push-sum", fanout="all", seed=seed,
+                    chunk_rounds=16, max_rounds=rounds, streak_target=2**30)
+    single = run_simulation(topo, cfg)
+    alive = np.asarray(single.final_state.alive)
+    assume(alive.any())
+    sharded = run_simulation_sharded(
+        topo, cfg, mesh=make_mesh(devices=cpu_devices[:devices])
+    )
+    assert single.rounds == rounds and sharded.rounds == rounds
+    for field in ("s", "w", "ratio"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(sharded.final_state, field))[alive],
+            np.asarray(getattr(single.final_state, field))[alive],
+            rtol=1e-5, atol=1e-7, err_msg=field,
+        )
+    w_total = float(np.asarray(sharded.final_state.w, np.float64).sum())
+    assert abs(w_total - n) < 1e-3 * max(n, 1)
+
+
+@given(g=random_graph(max_nodes=32), seed=st.integers(0, 2**31 - 1))
+@settings(**SETTINGS)
+def test_inverted_delivery_fuzzed_against_scatter(g, seed):
+    """delivery='invert' must reproduce the scatter trajectory to float
+    accumulation order on arbitrary graphs (isolated nodes, dead-at-birth
+    components, hubs up to the dense-table bound) — the exactness
+    contract of recomputed_hits, adversarially probed."""
+    n, edges = g
+    topo = csr_from_edges(n, edges, kind="fuzz")
+    deg_max = int(topo.degree.max()) if topo.degree.size else 0
+    assume(0 < deg_max <= 32)  # invert requires the dense table
+    rounds = 48
+    base = dict(algorithm="push-sum", seed=seed, chunk_rounds=16,
+                max_rounds=rounds, streak_target=2**30)
+    scatter = run_simulation(topo, RunConfig(delivery="scatter", **base))
+    invert = run_simulation(topo, RunConfig(delivery="invert", **base))
+    alive = np.asarray(scatter.final_state.alive)
+    assume(alive.any())
+    assert scatter.rounds == invert.rounds == rounds
+    for field in ("s", "w", "ratio"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(invert.final_state, field))[alive],
+            np.asarray(getattr(scatter.final_state, field))[alive],
+            rtol=1e-5, atol=1e-7, err_msg=field,
+        )
