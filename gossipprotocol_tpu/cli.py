@@ -53,6 +53,55 @@ def _build_config(args, algo, fault_plan, jnp):
     )
 
 
+def resume_argv(argv, checkpoint_dir, attempts_left):
+    """argv rewritten for a recovery exec: any prior --resume/--auto-resume
+    removed, --resume pinned to the run's own checkpoint dir (omitted when
+    no checkpoint landed before the crash — restart from scratch), and
+    --auto-resume set to the remaining attempt budget. Pure, for tests."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a in ("--resume", "--auto-resume"):
+            skip = True
+            continue
+        if a.startswith(("--resume=", "--auto-resume=")):
+            continue
+        out.append(a)
+    if checkpoint_dir is not None:
+        out += ["--resume", checkpoint_dir]
+    return out + ["--auto-resume", str(attempts_left)]
+
+
+def _is_runtime_death(e: BaseException) -> bool:
+    """The accelerator runtime is gone (not a program error): the axon
+    worker's watchdog kill surfaces as JaxRuntimeError UNAVAILABLE, after
+    which every call on this client fails the same way (measured)."""
+    return type(e).__name__ in ("JaxRuntimeError", "XlaRuntimeError") and (
+        "UNAVAILABLE" in str(e)
+    )
+
+
+def _reexec(new_argv) -> int:
+    """Replace this process with a fresh CLI invocation.
+
+    A new process gets a new jax client, which reconnects once the worker
+    has restarted; 10 s of grace covers the restart window observed on
+    this rig. Never returns in production (os.execv); the return type
+    exists so tests can monkeypatch it and assert on ``new_argv``.
+    """
+    import os
+    import time
+
+    time.sleep(10)
+    os.execv(
+        sys.executable,
+        [sys.executable, "-m", "gossipprotocol_tpu", *new_argv],
+    )
+    return 1  # pragma: no cover — execv does not return
+
+
 _ALGO_ALIASES = {
     "gossip": "gossip",
     "push-sum": "push-sum",
@@ -128,6 +177,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="chunks between checkpoints (0 = off)")
     p.add_argument("--resume", type=str, default=None,
                    help="checkpoint file (or dir) to resume from")
+    p.add_argument("--auto-resume", type=int, default=0, metavar="N",
+                   help="elastic recovery: if the accelerator runtime dies "
+                        "mid-run (e.g. a remote TPU worker killed by its "
+                        "watchdog) re-exec this CLI from the latest "
+                        "checkpoint, at most N times. The dead jax client "
+                        "cannot be revived in-process (measured: every "
+                        "retry keeps failing UNAVAILABLE), so recovery is "
+                        "a fresh process. With --checkpoint-dir/--checkpoint-"
+                        "every the run resumes from the latest checkpoint; "
+                        "without, it restarts from scratch")
     p.add_argument("--fail-fraction", type=float, default=0.0,
                    help="fault injection: kill this fraction of nodes")
     p.add_argument("--fail-round", type=int, default=0,
@@ -291,29 +350,65 @@ def main(argv=None) -> int:
                 )
                 return 2
 
-    # append when resuming: the file keeps covering the whole logical run
+    # append when resuming: the file keeps covering the whole logical run.
+    # Semantics are at-least-once — chunks after the last checkpoint are
+    # re-run on resume and their records re-emitted — so a resume writes a
+    # marker record first; consumers dedup on (round) after the marker.
     writer = (
         JsonlMetricsWriter(args.metrics_out, mode="a" if args.resume else "w")
         if args.metrics_out else None
     )
     if writer:
         cfg = dataclasses.replace(cfg, metrics_callback=writer)
+        if state is not None:
+            writer({
+                "event": "resumed",
+                "from_round": int(meta.get("round", -1)),
+                "note": "records after this marker may replay rounds "
+                        "already present above (at-least-once)",
+            })
 
     if not args.quiet:
         print_start_banner(algo)
 
-    with maybe_trace(args.profile_dir):
-        if args.devices > 1:
-            from gossipprotocol_tpu.parallel import run_simulation_sharded
+    try:
+        with maybe_trace(args.profile_dir):
+            if args.devices > 1:
+                from gossipprotocol_tpu.parallel import run_simulation_sharded
 
-            result = run_simulation_sharded(
-                topo, cfg, num_devices=args.devices, initial_state=state,
-                backend=None if args.backend == "auto" else args.backend,
-            )
-        elif state is not None:
-            result = resume_simulation(topo, cfg, state)
-        else:
-            result = run_simulation(topo, cfg)
+                result = run_simulation_sharded(
+                    topo, cfg, num_devices=args.devices, initial_state=state,
+                    backend=None if args.backend == "auto" else args.backend,
+                )
+            elif state is not None:
+                result = resume_simulation(topo, cfg, state)
+            else:
+                result = run_simulation(topo, cfg)
+    except Exception as e:
+        if not (_is_runtime_death(e) and args.auto_resume > 0):
+            raise
+        # elastic recovery (SURVEY.md §5.3): the client is unrecoverable
+        # in-process, so flush side channels and re-exec from the latest
+        # checkpoint (or from scratch if none landed yet)
+        if writer:
+            writer.close()
+        latest_ck = ckpt.latest(args.checkpoint_dir) if args.checkpoint_dir else None
+        # prefer this run's own newest checkpoint; else fall back to the
+        # checkpoint the user originally resumed from (discarding it would
+        # silently restart a long run from round 0); else from scratch
+        resume_target = (
+            args.checkpoint_dir if latest_ck else args.resume
+        )
+        effective = list(sys.argv[1:]) if argv is None else list(argv)
+        new_argv = resume_argv(effective, resume_target, args.auto_resume - 1)
+        print(
+            f"accelerator runtime died ({type(e).__name__}); "
+            + (f"resuming from {resume_target}" if resume_target
+               else "no checkpoint yet — restarting from scratch")
+            + f", {args.auto_resume - 1} recovery attempts left",
+            file=sys.stderr,
+        )
+        return _reexec(new_argv)
 
     if writer:
         writer.close()

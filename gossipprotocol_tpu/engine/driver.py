@@ -141,7 +141,8 @@ class RunConfig:
         clamped to [4, 4096] — or [1, 4096] when a single round already
         exceeds ~15 s, since then even the 4-round dispatch-amortization
         floor would bust the remote watchdog's single-dispatch budget
-        (~2 min; exceeding it crashes the TPU worker, observed twice).
+        (measured ~90 s on the axon rig; exceeding it crashes the TPU
+        worker, observed twice plus once under a controlled probe).
 
         The per-round cost model uses measured v5e worst-case rates
         (README roofline): ~100 ns/node for the node-sharded senders
