@@ -170,6 +170,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="erdos_renyi mean degree")
     p.add_argument("--attach", type=int, default=4,
                    help="power_law edges per new node (BA m)")
+    p.add_argument("--ws-k", type=int, default=6,
+                   help="small_world ring-lattice degree (even; k/2 chords "
+                        "per side)")
+    p.add_argument("--ws-beta", type=float, default=0.1,
+                   help="small_world rewiring probability in [0, 1] "
+                        "(0 = ring lattice, 1 = random graph)")
     p.add_argument("--metrics-out", type=str, default=None,
                    help="JSONL file for per-chunk metrics records")
     p.add_argument("--checkpoint-dir", type=str, default=None)
@@ -246,6 +252,7 @@ def main(argv=None) -> int:
         topo = build_topology(
             args.topology, args.num_nodes,
             seed=args.seed, avg_degree=args.avg_degree, m=args.attach,
+            k=args.ws_k, beta=args.ws_beta,
         )
     except ValueError as e:
         print(str(e), file=sys.stderr)
@@ -392,12 +399,35 @@ def main(argv=None) -> int:
         # checkpoint (or from scratch if none landed yet)
         if writer:
             writer.close()
-        latest_ck = ckpt.latest(args.checkpoint_dir) if args.checkpoint_dir else None
-        # prefer this run's own newest checkpoint; else fall back to the
-        # checkpoint the user originally resumed from (discarding it would
-        # silently restart a long run from round 0); else from scratch
+        # pick the FURTHEST-ALONG candidate checkpoint by round: the
+        # newest in --checkpoint-dir (this run's own, usually) vs the one
+        # the user originally resumed from. Comparing rounds guards
+        # against a stale leftover in the dir from an earlier experiment
+        # shadowing the real progress (or tripping resume validation and
+        # ending the recovery chain); --resume must never be silently
+        # discarded either way.
+        def _round_of(path_or_dir):
+            if not path_or_dir:
+                return None
+            path = path_or_dir
+            if os.path.isdir(path):
+                path = ckpt.latest(path)
+            if path is None or not os.path.exists(path):
+                return None
+            try:
+                return int(ckpt.peek_meta(path).get("round", -1))
+            except Exception:
+                return None
+
+        candidates = [
+            (r, target)
+            for target in (args.checkpoint_dir, args.resume)
+            if (r := _round_of(target)) is not None
+        ]
+        # key on round only: ties keep list order, preferring the run's
+        # own checkpoint dir
         resume_target = (
-            args.checkpoint_dir if latest_ck else args.resume
+            max(candidates, key=lambda t: t[0])[1] if candidates else None
         )
         effective = list(sys.argv[1:]) if argv is None else list(argv)
         new_argv = resume_argv(effective, resume_target, args.auto_resume - 1)

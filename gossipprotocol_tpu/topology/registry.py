@@ -28,6 +28,12 @@ _ALIASES = {
     "erdos_renyi": "erdos_renyi",
     "erdos-renyi": "erdos_renyi",
     "powerlaw": "power_law",
+    "small_world": "small_world",
+    "small-world": "small_world",
+    "smallworld": "small_world",
+    "watts_strogatz": "small_world",
+    "watts-strogatz": "small_world",
+    "ws": "small_world",
     "power_law": "power_law",
     "power-law": "power_law",
 }
@@ -43,6 +49,7 @@ register_topology("3D", builders.build_grid3d)
 register_topology("imp3D", builders.build_imp3d)
 register_topology("erdos_renyi", builders.build_erdos_renyi)
 register_topology("power_law", builders.build_power_law)
+register_topology("small_world", builders.build_small_world)
 
 
 def available_topologies() -> list[str]:

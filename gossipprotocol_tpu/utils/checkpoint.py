@@ -157,3 +157,15 @@ def latest(directory: str) -> str | None:
         if f.startswith("ckpt_round") and f.endswith(".npz")
     )
     return os.path.join(directory, cands[-1]) if cands else None
+
+
+def peek_meta(path: str) -> dict:
+    """Metadata only, without materializing the state arrays.
+
+    npz members load lazily, so this reads one small zip entry — cheap
+    even for a 10M-node checkpoint (whose arrays are ~hundreds of MB).
+    Used by recovery-target selection, which must compare the *rounds* of
+    candidate checkpoints before committing to one.
+    """
+    with np.load(path, allow_pickle=False) as z:
+        return json.loads(str(z["__meta__"]))

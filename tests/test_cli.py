@@ -340,3 +340,42 @@ def test_auto_resume_reexecs_from_latest_checkpoint(
     with _pytest.raises(Exception, match="UNAVAILABLE"):
         cli.main(["64", "imp3D", "push-sum", "--resume", ckdir,
                   "--auto-resume", "0", "--quiet"])
+
+
+def test_auto_resume_prefers_furthest_round_not_stale_leftover(
+    tmp_path, capsys, monkeypatch
+):
+    """--resume old_ck --checkpoint-dir dir where dir holds a STALE leftover
+    (fewer rounds than old_ck): recovery must re-exec from old_ck, not let
+    the leftover shadow real progress."""
+    import gossipprotocol_tpu.cli as cli
+
+    stale_dir = str(tmp_path / "stale")
+    far_dir = str(tmp_path / "far")
+    common = ["64", "imp3D", "push-sum", "--checkpoint-every", "1",
+              "--chunk-rounds", "4", "--quiet"]
+    code, _, _ = run_cli(
+        common + ["--checkpoint-dir", stale_dir, "--max-rounds", "4"], capsys)
+    assert code == 1
+    code, _, _ = run_cli(
+        common + ["--checkpoint-dir", far_dir, "--max-rounds", "12"], capsys)
+    assert code == 1
+
+    def die(*a, **kw):
+        import jax
+
+        raise jax.errors.JaxRuntimeError(
+            "UNAVAILABLE: TPU worker process crashed or restarted.")
+
+    captured = {}
+    import gossipprotocol_tpu.engine as eng
+    monkeypatch.setattr(eng, "resume_simulation", die)
+    monkeypatch.setattr(eng.driver, "resume_simulation", die)
+    monkeypatch.setattr(cli, "_reexec", lambda a: captured.setdefault("argv", a) and 0 or 0)
+
+    argv = common + ["--checkpoint-dir", stale_dir, "--resume", far_dir,
+                     "--auto-resume", "1"]
+    cli.main(argv)
+    got = captured["argv"]
+    i = got.index("--resume")
+    assert got[i + 1] == far_dir, got

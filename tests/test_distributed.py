@@ -57,6 +57,21 @@ assert state.counts.shape[0] == res.num_nodes
 print(f"FINGERPRINT rounds={res.rounds} converged={res.converged} "
       f"sum={int(counts.sum())} n={res.num_nodes} "
       f"ckpt_round={meta['round']}", flush=True)
+
+# fanout-all diffusion over the same 2-process mesh: its edge arrays are
+# sharded by source block (sharded_diffusion_edges) — a layout nothing
+# exercises across *processes* but this. No draws, so the only
+# cross-layout difference is float accumulation order.
+topo_d = build_topology("erdos_renyi", 64, seed=3)
+res_d = run_simulation_sharded(
+    topo_d,
+    RunConfig(algorithm="push-sum", fanout="all", seed=3,
+              predicate="global", tol=1e-4, chunk_rounds=64),
+    mesh=make_mesh(),
+)
+err = res_d.estimate_error
+print(f"DIFFUSION rounds={res_d.rounds} converged={res_d.converged} "
+      f"err_ok={err is not None and err < 2e-4}", flush=True)
 """
 
 
@@ -113,3 +128,12 @@ def test_two_process_mesh_matches_single_chip(tmp_path):
                 f"sum={int(counts.sum())} n={res.num_nodes} "
                 f"ckpt_round={res.rounds}")
     assert fps[0] == expected
+
+    # diffusion over the process-sharded edge layout: both processes agree
+    # and the run converges to the certified mean
+    dfs = [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("DIFFUSION")
+    ]
+    assert len(dfs) == 2 and dfs[0] == dfs[1], outs
+    assert "converged=True" in dfs[0] and "err_ok=True" in dfs[0], dfs[0]

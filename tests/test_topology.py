@@ -141,3 +141,61 @@ def test_birth_alive_cached_and_component_aware():
     a1 = t.birth_alive()
     assert list(a1) == [True, True, True, True, False, False]
     assert t.birth_alive() is a1  # cached, not recomputed
+
+
+# --- small_world (Watts–Strogatz; beyond-reference family) ----------------
+
+def test_small_world_beta0_is_ring_lattice():
+    topo = build_topology("small_world", 120, k=6, beta=0.0, seed=0)
+    deg = np.asarray(topo.degree)
+    assert (deg == 6).all()
+    topo.validate()
+    # ring chords: node 0's neighbors are exactly {±1, ±2, ±3 mod n}
+    nbrs0 = set(np.asarray(topo.indices[: topo.offsets[1]]))
+    assert nbrs0 == {1, 2, 3, 117, 118, 119}
+
+
+def test_small_world_beta1_loses_the_lattice():
+    topo = build_topology("small_world", 400, k=6, beta=1.0, seed=1)
+    deg = np.asarray(topo.degree)
+    # fully rewired: mean degree stays ~k (drops only for self/dup draws)
+    assert 5.0 < deg.mean() <= 6.0
+    # ...and the degree distribution is no longer constant
+    assert deg.min() < 6 or deg.max() > 6
+    topo.validate()
+
+
+def test_small_world_deterministic_and_aliased():
+    a = build_topology("watts_strogatz", 200, k=4, beta=0.3, seed=7)
+    b = build_topology("ws", 200, k=4, beta=0.3, seed=7)
+    np.testing.assert_array_equal(np.asarray(a.indices), np.asarray(b.indices))
+    c = build_topology("small_world", 200, k=4, beta=0.3, seed=8)
+    assert not np.array_equal(np.asarray(a.indices), np.asarray(c.indices))
+
+
+def test_small_world_rejects_bad_params():
+    import pytest
+
+    with pytest.raises(ValueError, match="beta"):
+        build_topology("small_world", 100, k=6, beta=1.5)
+    with pytest.raises(ValueError, match="num_nodes"):
+        build_topology("small_world", 5, k=6, beta=0.1)
+
+
+def test_small_world_gossip_converges():
+    from gossipprotocol_tpu import RunConfig, run_simulation
+
+    topo = build_topology("small_world", 256, k=6, beta=0.1, seed=0)
+    res = run_simulation(topo, RunConfig(algorithm="gossip", seed=0))
+    assert res.converged
+    # small-world regime: far faster than a pure ring of the same size
+    ring = build_topology("small_world", 256, k=6, beta=0.0, seed=0)
+    res_ring = run_simulation(ring, RunConfig(algorithm="gossip", seed=0))
+    assert res.rounds < res_ring.rounds
+
+
+def test_small_world_rejects_odd_k():
+    import pytest
+
+    with pytest.raises(ValueError, match="even"):
+        build_topology("small_world", 100, k=7, beta=0.1)
