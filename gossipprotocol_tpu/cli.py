@@ -399,13 +399,18 @@ def main(argv=None) -> int:
         # checkpoint (or from scratch if none landed yet)
         if writer:
             writer.close()
-        # pick the FURTHEST-ALONG candidate checkpoint by round: the
+        # pick the FURTHEST-ALONG *compatible* candidate checkpoint: the
         # newest in --checkpoint-dir (this run's own, usually) vs the one
-        # the user originally resumed from. Comparing rounds guards
-        # against a stale leftover in the dir from an earlier experiment
-        # shadowing the real progress (or tripping resume validation and
-        # ending the recovery chain); --resume must never be silently
+        # the user originally resumed from. Compatibility (trajectory
+        # fields + graph fingerprint, the same rules the resume block
+        # enforces) is checked BEFORE the round comparison — a stale
+        # leftover in the dir from a different experiment must neither
+        # shadow real progress nor win only to trip resume validation
+        # and end the recovery chain; --resume must never be silently
         # discarded either way.
+        traj = ckpt.trajectory_meta(cfg)
+        fp = ckpt.topology_fingerprint(topo)
+
         def _round_of(path_or_dir):
             if not path_or_dir:
                 return None
@@ -415,9 +420,15 @@ def main(argv=None) -> int:
             if path is None or not os.path.exists(path):
                 return None
             try:
-                return int(ckpt.peek_meta(path).get("round", -1))
+                m = ckpt.peek_meta(path)
             except Exception:
-                return None
+                return None  # published ckpts are atomic; treat junk as absent
+            compatible = (
+                all(ckpt.field_matches(m, k, v) for k, v in traj.items())
+                and m.get("topology") in (None, topo.kind)
+                and m.get("adjacency") in (None, fp)
+            )
+            return int(m.get("round", -1)) if compatible else None
 
         candidates = [
             (r, target)

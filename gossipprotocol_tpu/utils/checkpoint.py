@@ -149,12 +149,19 @@ def load(path: str) -> Tuple[object, dict]:
 
 
 def latest(directory: str) -> str | None:
-    """Path of the newest checkpoint in ``directory``, or None."""
+    """Path of the newest checkpoint in ``directory``, or None.
+
+    ``.tmp.npz`` files are in-flight writes (``save`` publishes via
+    ``os.replace``): a crash mid-save can leave a truncated one behind,
+    and it must never shadow the last *published* checkpoint — published
+    files are atomic-renamed and therefore always complete.
+    """
     if not os.path.isdir(directory):
         return None
     cands = sorted(
         f for f in os.listdir(directory)
         if f.startswith("ckpt_round") and f.endswith(".npz")
+        and not f.endswith(".tmp.npz")
     )
     return os.path.join(directory, cands[-1]) if cands else None
 

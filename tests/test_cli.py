@@ -379,3 +379,53 @@ def test_auto_resume_prefers_furthest_round_not_stale_leftover(
     got = captured["argv"]
     i = got.index("--resume")
     assert got[i + 1] == far_dir, got
+
+
+def test_latest_ignores_inflight_tmp_files(tmp_path):
+    """A crash mid-save can leave a truncated ckpt_roundN.npz.tmp.npz that
+    sorts after the real files — latest() must never return it."""
+    from gossipprotocol_tpu.utils import checkpoint as ckpt
+
+    d = tmp_path / "ck"
+    d.mkdir()
+    (d / "ckpt_round000000004.npz").write_bytes(b"real")
+    (d / "ckpt_round000000008.npz.tmp.npz").write_bytes(b"trunc")
+    assert ckpt.latest(str(d)).endswith("ckpt_round000000004.npz")
+
+
+def test_auto_resume_skips_incompatible_stale_dir(
+    tmp_path, capsys, monkeypatch
+):
+    """A HIGHER-round leftover in --checkpoint-dir from a different
+    experiment (other seed) must not win recovery-target selection — it
+    would trip resume validation in the re-exec'd process and end the
+    recovery chain. The compatible --resume checkpoint wins instead."""
+    import gossipprotocol_tpu.cli as cli
+
+    stale_dir = str(tmp_path / "stale")   # seed 9: incompatible, MORE rounds
+    good_dir = str(tmp_path / "good")     # seed 4: compatible, fewer rounds
+    common = ["64", "imp3D", "push-sum", "--checkpoint-every", "1",
+              "--chunk-rounds", "4", "--quiet"]
+    code, _, _ = run_cli(common + ["--seed", "9", "--checkpoint-dir",
+                                   stale_dir, "--max-rounds", "12"], capsys)
+    assert code == 1
+    code, _, _ = run_cli(common + ["--seed", "4", "--checkpoint-dir",
+                                   good_dir, "--max-rounds", "4"], capsys)
+    assert code == 1
+
+    def die(*a, **kw):
+        import jax
+
+        raise jax.errors.JaxRuntimeError(
+            "UNAVAILABLE: TPU worker process crashed or restarted.")
+
+    captured = {}
+    import gossipprotocol_tpu.engine as eng
+    monkeypatch.setattr(eng, "resume_simulation", die)
+    monkeypatch.setattr(eng.driver, "resume_simulation", die)
+    monkeypatch.setattr(cli, "_reexec", lambda a: captured.setdefault("argv", a) and 0 or 0)
+
+    cli.main(common + ["--seed", "4", "--checkpoint-dir", stale_dir,
+                       "--resume", good_dir, "--auto-resume", "1"])
+    got = captured["argv"]
+    assert got[got.index("--resume") + 1] == good_dir, got
