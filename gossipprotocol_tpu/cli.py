@@ -23,6 +23,7 @@ invalid" on unknown algorithms (``Program.fs:207``).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 def _build_config(args, algo, fault_plan, jnp):
@@ -91,7 +92,6 @@ def _reexec(new_argv) -> int:
     this rig. Never returns in production (os.execv); the return type
     exists so tests can monkeypatch it and assert on ``new_argv``.
     """
-    import os
     import time
 
     time.sleep(10)
@@ -199,6 +199,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="round at which the failures strike")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="emit a jax.profiler trace here")
+    p.add_argument("--compile-cache", type=str,
+                   default=os.environ.get(
+                       "GOSSIP_TPU_COMPILE_CACHE",
+                       os.path.expanduser("~/.cache/gossipprotocol_tpu/xla"),
+                   ),
+                   metavar="DIR",
+                   help="persistent XLA compilation cache (default shown; "
+                        "'' disables). Measured: cached reruns cut "
+                        "compile_ms 7x on CPU (1.19 s -> 0.17 s at 100k); "
+                        "through the remote-TPU tunnel the reported "
+                        "compile window is program-load/upload-bound, so "
+                        "savings there are marginal")
     p.add_argument("--check", action="store_true",
                    help="build and validate the topology, print its shape "
                         "summary, and exit without simulating")
@@ -210,12 +222,26 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    import os
-
     import jax
 
     if args.x64:
         jax.config.update("jax_enable_x64", True)
+
+    if args.compile_cache:
+        # persistent XLA compile cache (measured: 7x on CPU reruns; the
+        # remote-TPU tunnel's compile window is load/upload-bound, so
+        # marginal there). Thresholds zeroed so CLI-scale programs cache
+        # too. Best-effort: an unwritable HOME (read-only container)
+        # must degrade to cache-off, not crash a working CLI.
+        # GOSSIP_TPU_COMPILE_CACHE= (empty) disables via the default.
+        try:
+            os.makedirs(args.compile_cache, exist_ok=True)
+        except OSError as e:
+            print(f"compile cache disabled ({e})", file=sys.stderr)
+        else:
+            jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
     if args.backend != "auto":
         # This image's sitecustomize pre-imports jax, so flipping

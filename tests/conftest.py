@@ -22,6 +22,9 @@ _golden = os.environ.get("GOLDEN_BACKEND")
 os.environ.setdefault(
     "JAX_PLATFORMS", f"{_golden},cpu" if _golden else "cpu"
 )
+# CLI tests must reuse the suite's compile cache below, not mutate the
+# developer's ~/.cache (the CLI's --compile-cache default honors this)
+os.environ.setdefault("GOSSIP_TPU_COMPILE_CACHE", "/tmp/jax_compile_cache")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
