@@ -4,7 +4,8 @@ The reference's positional surface is preserved exactly:
 
     python -m gossipprotocol_tpu <num_nodes> <topology> <algorithm>
 
-with ``topology`` ∈ {line, full, 3D, imp3D, erdos_renyi, power_law} and
+with ``topology`` ∈ {line, full, 3D, imp3D, erdos_renyi, power_law,
+small_world} and
 ``algorithm`` ∈ {gossip, push-sum} (hyphenated, matching the reference's
 match arm ``Program.fs:196-205``; "push_sum"/"pushsum" accepted as
 aliases). Output is format-compatible: the start banner
@@ -13,9 +14,13 @@ aliases). Output is format-compatible: the start banner
 
 Beyond the reference (north-star flags, BASELINE.json): ``--backend``,
 ``--seed``, ``--threshold``, ``--eps``, ``--streak``, ``--max-rounds``,
-``--semantics``, ``--metrics-out``, ``--checkpoint-dir``, ``--resume``,
+``--semantics``, ``--predicate/--tol`` (sound convergence),
+``--fanout`` (diffusion push-sum), ``--delivery`` (scatter vs gather
+inversion), ``--metrics-out``, ``--checkpoint-dir``, ``--resume``,
+``--auto-resume`` (elastic recovery), ``--compile-cache``,
 ``--fail-fraction/--fail-round``, ``--devices`` (multi-chip sharding),
-``--profile-dir``. Invalid input errors loudly — the reference silently
+``--ws-k/--ws-beta`` (small-world knobs), ``--profile-dir``. Invalid
+input errors loudly — the reference silently
 no-ops on unknown topologies (``Program.fs:279``) and prints "option
 invalid" on unknown algorithms (``Program.fs:207``).
 """
