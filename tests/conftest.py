@@ -54,3 +54,20 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def cpu_devices():
     return _CPU_DEVICES
+
+
+@pytest.fixture(scope="session")
+def native_oracle():
+    """Skip unless the native async oracle builds and loads — the one
+    guard for every test that drives asyncsim (test_asyncsim,
+    test_experiments), so build/availability semantics live in one place
+    and make runs at most once per session."""
+    from gossipprotocol_tpu import native
+
+    try:
+        native.build_library()
+    except Exception as e:
+        pytest.skip(f"cannot build native libraries: {e}")
+    if not native.async_available():
+        pytest.skip("async oracle unavailable")
+    return native

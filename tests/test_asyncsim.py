@@ -10,13 +10,8 @@ from gossipprotocol_tpu import build_topology, native
 
 
 @pytest.fixture(scope="module", autouse=True)
-def built():
-    try:
-        native.build_library()
-    except Exception as e:
-        pytest.skip(f"cannot build native libraries: {e}")
-    if not native.async_available():
-        pytest.skip("async oracle unavailable")
+def built(native_oracle):
+    """Module-wide guard, delegated to the shared session fixture."""
 
 
 def test_async_gossip_converges_all_reference_topologies():

@@ -10,8 +10,6 @@ the CPU backend keep these under a few seconds each.
 import csv
 import os
 
-import pytest
-
 
 def test_curves_tool_writes_expected_columns(tmp_path):
     from gossipprotocol_tpu.experiments.curves import main
@@ -39,19 +37,7 @@ def test_curves_tool_writes_expected_columns(tmp_path):
     assert os.path.getsize(jout) > 0
 
 
-def test_oracle_tool_calibrates_and_checks_shape(tmp_path):
-    from gossipprotocol_tpu import native
-
-    # same guard pattern as tests/test_asyncsim.py: build_library raises
-    # without a toolchain, and a built-but-unloadable .so still means the
-    # oracle is unavailable
-    try:
-        native.build_library()
-    except Exception as e:  # pragma: no cover
-        pytest.skip(f"native toolchain unavailable: {e}")
-    if not native.async_available():  # pragma: no cover
-        pytest.skip("native asyncsim unavailable")
-
+def test_oracle_tool_calibrates_and_checks_shape(tmp_path, native_oracle):
     from gossipprotocol_tpu.experiments.oracle_curves import main
 
     out = str(tmp_path / "o.csv")

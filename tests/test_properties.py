@@ -19,8 +19,14 @@ from hypothesis import strategies as st
 from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
 from gossipprotocol_tpu.topology import csr_from_edges
 
+# GOSSIP_TPU_FUZZ_EXAMPLES raises the per-property example budget for
+# deep-fuzz sessions (e.g. =200 before a release); the default keeps the
+# suite fast. Hypothesis's example database persists found failures
+# either way, so a deep session's counterexamples replay in normal runs.
+import os
+
 SETTINGS = dict(
-    max_examples=15,
+    max_examples=int(os.environ.get("GOSSIP_TPU_FUZZ_EXAMPLES", "15")),
     deadline=None,
     suppress_health_check=[HealthCheck.too_slow],
 )
