@@ -278,3 +278,37 @@ def test_inverted_delivery_sharded_rejected(cpu_devices):
     cfg = RunConfig(algorithm="push-sum", delivery="invert")
     with pytest.raises(ValueError, match="single-chip only"):
         run_simulation_sharded(topo, cfg, mesh=make_mesh(devices=cpu_devices[:8]))
+
+
+def test_f32_dry_spell_underflow_scale_wall():
+    """The 100M-scale wall, pinned at n=51: a node in a receipt dry spell
+    halves (s, w) every round, so a gap of ~150 rounds drives f32 w
+    through the subnormals to exactly 0. At n=1e8 on sparse ER the
+    extreme-value dry spell reaches ~600 rounds (a leaf whose high-degree
+    neighbor never draws it), so single-target f32 push-sum cannot certify
+    the global tolerance at that scale — measured live: ratio outliers
+    grow ~2^round past round ~80 and converged stays 0
+    (artifacts/pushsum_100M_singletarget_underflow.jsonl). float64's
+    5e-324 subnormal floor covers ~1000-round gaps, and fanout-all diffusion
+    receives from every neighbor every round, so dry spells cannot exist
+    — the variant that actually scales (README "Performance")."""
+    from gossipprotocol_tpu import RunConfig, run_simulation
+    from gossipprotocol_tpu.topology import csr_from_edges
+
+    k = 50
+    edges = np.stack([np.zeros(k, np.int64), np.arange(1, k + 1)], axis=1)
+    topo = csr_from_edges(k + 1, edges, kind="star")
+    base = dict(algorithm="push-sum", seed=0, chunk_rounds=64,
+                max_rounds=400, streak_target=2**30)
+    res = run_simulation(topo, RunConfig(**base))
+    w32 = np.asarray(res.final_state.w)
+    assert (w32 == 0).any(), "expected f32 dry-spell underflow on the star"
+    # (--x64's fix is range arithmetic, not tested here: 2^-400 ≈ 4e-121
+    # sits far above float64's 5e-324 subnormal floor, and enabling x64
+    # inside the suite would flip global jax config for every other test)
+
+    # diffusion structurally has no dry spells: every node receives from
+    # every neighbor every round, so w stays in a bounded band
+    resd = run_simulation(topo, RunConfig(fanout="all", **base))
+    wd = np.asarray(resd.final_state.w)
+    assert (wd > 1e-6).all()
