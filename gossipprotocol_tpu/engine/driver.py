@@ -454,6 +454,14 @@ def chunk_stats(state, done_fn) -> dict:
         big = jnp.asarray(jnp.inf, state.ratio.dtype)
         rec["ratio_min"] = jnp.min(jnp.where(state.alive, state.ratio, big))
         rec["ratio_max"] = jnp.max(jnp.where(state.alive, state.ratio, -big))
+        # dry-spell underflow detector (the measured 100M f32 wall): an
+        # alive node with w == 0 has halved through the float subnormals
+        # during a receipt dry spell — its ratio is garbage and the
+        # global predicate can never certify it. Counted on device so
+        # the driver can warn with the cure instead of grinding silently.
+        rec["w_underflow"] = jnp.sum(
+            (state.alive & (state.w == 0)).astype(jnp.int32)
+        )
     return rec
 
 
@@ -510,6 +518,7 @@ def _drive(
     metrics: List[dict] = []
     checkpoints: List[str] = []
     chunk_i = 0
+    underflow_warned = False
     cur_round = 0
     done = False
     checkpointing = bool(cfg.checkpoint_every and cfg.checkpoint_dir)
@@ -553,6 +562,21 @@ def _drive(
         cur_round = int(host.pop("round"))
         done = bool(host.pop("done"))
         rec = {"round": cur_round, **{k: v.item() for k, v in host.items()}}
+        if rec.get("w_underflow", 0) and not underflow_warned:
+            # measured failure mode (README "Convergence-predicate
+            # soundness", 100M artifact): warn once with the cures
+            # instead of grinding to max_rounds with garbage ratios
+            import sys as _sys
+
+            print(
+                f"warning: {rec['w_underflow']} alive node(s) underflowed "
+                "w to 0 in a receipt dry spell — float32 single-target "
+                "push-sum cannot certify convergence past this point. "
+                "Use --fanout all (no dry spells by construction) or "
+                "--x64 (covers ~1000-round gaps).",
+                file=_sys.stderr,
+            )
+            underflow_warned = True
         stalled = not done and rec.get("spreading") == 0
         if stalled:
             # gossip liveness failure: no node can ever deliver another hit

@@ -281,6 +281,11 @@ def make_sharded_chunk_runner(
             stats["ratio_max"] = jax.lax.pmax(
                 jnp.max(jnp.where(final.alive, final.ratio, -big)), NODES_AXIS
             )
+            # mirrors chunk_stats' dry-spell underflow detector
+            stats["w_underflow"] = jax.lax.psum(
+                jnp.sum((final.alive & (final.w == 0)).astype(jnp.int32)),
+                NODES_AXIS,
+            )
         else:
             from gossipprotocol_tpu.engine.driver import gossip_spreading_count
 
@@ -309,7 +314,7 @@ def make_sharded_chunk_runner(
 
     stats_fields = ["round", "done", "converged", "alive"]
     if cfg.algorithm != "gossip":
-        stats_fields += ["ratio_min", "ratio_max"]
+        stats_fields += ["ratio_min", "ratio_max", "w_underflow"]
     else:
         stats_fields += ["spreading"]
     stats_specs = {k: P() for k in stats_fields}

@@ -215,9 +215,12 @@ def finish_pushsum_round(
     the fanout-all diffusion round (:mod:`protocols.diffusion`) — so the
     predicate semantics cannot drift between the two.
     """
-    # w stays strictly positive for every alive node (each keeps a
-    # positive fraction of a positive weight); the maximum only guards
-    # dead/isolated rows.
+    # The maximum guards dead/isolated rows AND alive nodes in deep
+    # receipt dry spells: (s, w) halve every send-only round, so a
+    # ~150-round gap drives float32 w through the subnormals to exactly
+    # 0 (the measured 100M-scale wall — README "Convergence-predicate
+    # soundness"; chunk stats count these as w_underflow). Removing the
+    # guard would turn those rows into 0/0 NaNs.
     ratio_new = s_new / jnp.maximum(w_new, jnp.asarray(1e-30, w_new.dtype))
 
     if reference_semantics:

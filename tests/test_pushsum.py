@@ -312,3 +312,43 @@ def test_f32_dry_spell_underflow_scale_wall():
     resd = run_simulation(topo, RunConfig(fanout="all", **base))
     wd = np.asarray(resd.final_state.w)
     assert (wd > 1e-6).all()
+
+
+def test_w_underflow_detector_single_and_sharded(capsys, cpu_devices):
+    """The engine counts alive nodes whose w underflowed to 0 (the
+    dry-spell wall's runtime signature) in every chunk record — single
+    chip and the shard_map mirror — and warns once with the cures,
+    instead of grinding silently with garbage ratios."""
+    from gossipprotocol_tpu import RunConfig, run_simulation
+    from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+    from gossipprotocol_tpu.topology import csr_from_edges
+
+    k = 50
+    edges = np.stack([np.zeros(k, np.int64), np.arange(1, k + 1)], axis=1)
+    topo = csr_from_edges(k + 1, edges, kind="star")
+    cfg = RunConfig(algorithm="push-sum", seed=0, chunk_rounds=64,
+                    max_rounds=400, streak_target=2**30)
+    res = run_simulation(topo, cfg)
+    assert any(m.get("w_underflow", 0) > 0 for m in res.metrics)
+    err = capsys.readouterr().err
+    assert "underflowed" in err and "--fanout all" in err
+
+    # the sharded psum mirror: the field must exist in every chunk record
+    # and agree with the sharded run's own final state. The COUNT is
+    # lowering-dependent — the single-chip XLA:CPU codegen flushes
+    # subnormals to zero (w hits exact 0 at ~2^-126) while the shard_map
+    # lowering preserves them (exact 0 only at ~2^-149) — so equality
+    # with the single-chip count is NOT a theorem; self-consistency is.
+    res_sh = run_simulation_sharded(
+        topo, cfg, mesh=make_mesh(devices=cpu_devices[:2]))
+    assert all("w_underflow" in m for m in res_sh.metrics)
+    st_sh = res_sh.final_state
+    final_count = int(
+        (np.asarray(st_sh.alive) & (np.asarray(st_sh.w) == 0)).sum()
+    )
+    assert res_sh.metrics[-1]["w_underflow"] == final_count
+
+    # healthy configs report zero and stay quiet
+    topo2 = build_topology("full", 64)
+    res2 = run_simulation(topo2, RunConfig(algorithm="push-sum", seed=0))
+    assert all(m.get("w_underflow", 0) == 0 for m in res2.metrics)
