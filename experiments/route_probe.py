@@ -1,0 +1,255 @@
+"""Probe the primitives a static-routing delivery kernel would stand on.
+
+The scatter floor (README "Roofline") is XLA's serialized lowering of
+`segment_sum` with uniform-random segment ids.  Because the diffusion
+edge list — and push-sum's dense neighbor table — are *static*, delivery
+is really `out = segment_sum(vals[perm], sorted_dst)` with a
+build-time-known permutation `perm`.  A permutation decomposes into
+VMEM-tile-local shuffles (take_along_axis passes, Hall routing) plus one
+block transpose through HBM staging — all vectorizable.  This probe
+measures, on the real chip, every primitive that plan needs.
+
+Timing discipline (memory: tpu-rig-run-discipline): the axon tunnel adds
+~100 ms per host round-trip, so every op is amortized over R iterations
+inside ONE jitted `fori_loop` dispatch, with a multiplicative carry so
+XLA cannot hoist the op out of the loop.  Support probes (pallas dim-0
+gather, VMEM residency) are single calls — pass/fail is the datum.
+
+Usage: python experiments/route_probe.py [--e 8000000] [--n 1000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+R = 32  # amortization iterations per dispatch
+
+
+def sync(x):
+    return float(jax.device_get(jnp.sum(x.ravel()[:8].astype(jnp.float32))))
+
+
+def timed(fn, repeats=3):
+    fn()  # compile + program load + upload
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def report(name, secs_per_op, nbytes):
+    gbps = nbytes / secs_per_op / 1e9
+    print(f"{name:46s} {secs_per_op*1e3:9.3f} ms  {gbps:8.1f} GB/s",
+          flush=True)
+
+
+def loop(op, *carry):
+    """R iterations of `carry = op(i, carry)` in one dispatch."""
+
+    @jax.jit
+    def run(*c):
+        def body(i, c):
+            return op(i, *c)
+        return jax.lax.fori_loop(0, R, body, c)
+
+    return run, carry
+
+
+def bench(name, op, nbytes, *carry):
+    run, c = loop(op, *carry)
+    t = timed(lambda: sync(run(*c)[0])) / R
+    report(name, t, nbytes)
+    return t
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--e", type=int, default=8_000_000)
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--skip-pallas", action="store_true")
+    args = ap.parse_args()
+    E, N = args.e, args.n
+    rng = np.random.default_rng(0)
+    print(f"device: {jax.devices()[0]}  E={E} N={N}  R={R}", flush=True)
+
+    tgt = jnp.asarray(rng.integers(0, N, size=E), jnp.int32)
+    tgt_sorted = jnp.sort(tgt)
+    vals0 = jnp.asarray(rng.standard_normal(E), jnp.float32)
+
+    def perturb(i, v):
+        return v * (1.0 + i.astype(jnp.float32) * 1e-12)
+
+    def chain(v, scalar):
+        # fold a result scalar back into the carry so XLA cannot DCE the op
+        return v * (1.0 + scalar * 1e-30)
+
+    # elementwise stream baseline: what "fast" means on this stack
+    bench("elementwise multiply (stream baseline)",
+          lambda i, v: (perturb(i, v),), 8 * E, vals0)
+
+    def op_scat(i, v):
+        out = jax.ops.segment_sum(v, tgt, num_segments=N)
+        return (chain(v, out[0]),)
+    bench("segment_sum random ids (baseline)", op_scat,
+          8 * E + 4 * N, vals0)
+
+    vals2 = jnp.stack([vals0, vals0], axis=-1)
+
+    def op_scat2(i, v):
+        out = jax.ops.segment_sum(v, tgt, num_segments=N)
+        return (chain(v, out[0, 0]),)
+    bench("segment_sum random ids [E,2] stacked", op_scat2,
+          12 * E + 8 * N, vals2)
+
+    def op_sorted(i, v):
+        out = jax.ops.segment_sum(v, tgt_sorted, num_segments=N,
+                                  indices_are_sorted=True)
+        return (chain(v, out[0]),)
+    bench("segment_sum SORTED ids", op_sorted, 8 * E + 4 * N, vals0)
+
+    def op_cumsum(i, v):
+        out = jnp.cumsum(v)
+        return (chain(v, out[-1]),)
+    bench("cumsum over E", op_cumsum, 8 * E, vals0)
+
+    # ---- XLA batched take_along_axis ------------------------------------
+    W = 4096 * 128
+    T = max(1, E // W)
+    data3 = jnp.asarray(rng.standard_normal((T, 4096, 128)), jnp.float32)
+    idx_r = jnp.asarray(rng.integers(0, 4096, size=(T, 4096, 128)), jnp.int32)
+    idx_c = jnp.asarray(rng.integers(0, 128, size=(T, 4096, 128)), jnp.int32)
+    nb = T * W * 4 * 3
+
+    bench("XLA take_along_axis dim0 (sublanes)",
+          lambda i, d: (jnp.take_along_axis(d, idx_r, axis=1),),
+          nb, data3)
+    bench("XLA take_along_axis dim1 (lanes)",
+          lambda i, d: (jnp.take_along_axis(d, idx_c, axis=2),),
+          nb, data3)
+
+    B, P = 16, W // 16
+    stg = jnp.asarray(rng.standard_normal((T, B, P)), jnp.float32)
+    bench("XLA [T,B,P]->[B,T,P] transpose",
+          lambda i, d: (jnp.transpose(d, (1, 0, 2)).transpose(1, 0, 2)
+                        * 1.0000001,),
+          T * B * P * 8, stg)
+
+    if args.skip_pallas:
+        return
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def copy_kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    # VMEM residency ceiling for a single resident block
+    for mb in (2, 4, 8, 12, 16):
+        rows = mb * 1024 * 1024 // (128 * 4)
+        xb = jnp.ones((rows, 128), jnp.float32)
+        try:
+            y = pl.pallas_call(
+                copy_kernel,
+                out_shape=jax.ShapeDtypeStruct(xb.shape, xb.dtype),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            )(xb)
+            sync(y)
+            print(f"VMEM probe {mb:3d} MB resident block: OK", flush=True)
+        except Exception as ex:  # noqa: BLE001
+            print(f"VMEM probe {mb:3d} MB: FAILED ({type(ex).__name__})",
+                  flush=True)
+            break
+
+    # dim-0 (sublane) gather support, by row count
+    for rows in (8, 256, 1024, 4096):
+        xg = jnp.ones((rows, 128), jnp.float32)
+        ig = jnp.asarray(rng.integers(0, rows, size=(rows, 128)), jnp.int32)
+
+        def g0(x_ref, i_ref, o_ref):
+            o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=0)
+
+        try:
+            y = pl.pallas_call(
+                g0,
+                out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                          pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            )(xg, ig)
+            sync(y)
+            print(f"pallas dim0 gather rows={rows:5d}: OK", flush=True)
+        except Exception as ex:  # noqa: BLE001
+            print(f"pallas dim0 gather rows={rows:5d}: FAILED "
+                  f"({type(ex).__name__})", flush=True)
+
+    # wide-row (cross-vreg lane) gather support
+    for cols in (128, 512, 4096):
+        xg = jnp.ones((128, cols), jnp.float32)
+        ig = jnp.asarray(rng.integers(0, cols, size=(128, cols)), jnp.int32)
+
+        def g1(x_ref, i_ref, o_ref):
+            o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=1)
+
+        try:
+            y = pl.pallas_call(
+                g1,
+                out_shape=jax.ShapeDtypeStruct((128, cols), jnp.float32),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                          pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            )(xg, ig)
+            sync(y)
+            print(f"pallas dim1 gather cols={cols:5d}: OK", flush=True)
+        except Exception as ex:  # noqa: BLE001
+            print(f"pallas dim1 gather cols={cols:5d}: FAILED "
+                  f"({type(ex).__name__})", flush=True)
+
+    # amortized pallas dim1 gather throughput at tile scale
+    grid_call = pl.pallas_call(
+        lambda x_ref, i_ref, o_ref: o_ref.__setitem__(
+            0, jnp.take_along_axis(x_ref[0], i_ref[0], axis=1)),
+        grid=(T,),
+        out_shape=jax.ShapeDtypeStruct((T, 4096, 128), jnp.float32),
+        in_specs=[
+            pl.BlockSpec((1, 4096, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 4096, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 4096, 128), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    try:
+        bench("pallas dynamic_gather dim1 (tiled)",
+              lambda i, d: (grid_call(d, idx_c),), nb, data3)
+    except Exception as ex:  # noqa: BLE001
+        print(f"pallas dynamic_gather dim1 (tiled): FAILED "
+              f"({type(ex).__name__})", flush=True)
+
+    # pallas HBM->VMEM->HBM streaming copy: the achievable stream ceiling
+    big_rows = 64 * 1024 * 1024 // (128 * 4)  # 64 MB
+    xs = jnp.ones((big_rows, 128), jnp.float32)
+    stream_call = pl.pallas_call(
+        copy_kernel,
+        grid=(big_rows // 1024,),
+        out_shape=jax.ShapeDtypeStruct((big_rows, 128), jnp.float32),
+        in_specs=[pl.BlockSpec((1024, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1024, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    bench("pallas grid stream copy 64MB",
+          lambda i, d: (stream_call(d) * 1.0,),
+          big_rows * 128 * 8, xs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,158 @@
+"""Second routing probe: does blocking beat the scatter floor?
+
+route_probe.py showed XLA's random scatter runs at ~7 ns/element into a
+1M-row (4 MB) target and the practical stream ceiling of this rig is
+~46 GB/s.  The static-edge delivery plan needs to know how scatter and
+gather per-element cost scale with the *working-set size* — if a scatter
+into a 64K-row window is much cheaper per element, then sorting edges by
+dst-block at build time (free: the edge list is static) turns one huge
+scatter into B cache-friendly small ones, pure XLA, no Mosaic.
+
+Probes (all amortized over R iterations in one dispatch):
+  scatter  E=8M -> N in {16K, 64K, 256K, 1M, 4M}
+  gather   E=8M random ids from tables of the same sizes
+  gather   E=8M SORTED ids from a 1M table (the "expand" op)
+  repeat   share[1M] by static degrees to 8M (jnp.repeat fixed total)
+  scan-of-blocked-scatters: the actual candidate delivery, 8M msgs
+      pre-partitioned into B blocks of a 1M-row target
+
+Usage: python experiments/route_probe2.py [--e 8000000] [--n 1000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+R = 32
+
+
+def sync(x):
+    return float(jax.device_get(jnp.sum(x.ravel()[:8].astype(jnp.float32))))
+
+
+def timed(fn, repeats=3):
+    fn()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench(name, op, *carry, nelem):
+    @jax.jit
+    def run(*c):
+        return jax.lax.fori_loop(0, R, lambda i, c: op(i, *c), c)
+
+    t = timed(lambda: sync(run(*carry)[0])) / R
+    print(f"{name:52s} {t*1e3:9.3f} ms  {t/nelem*1e9:7.2f} ns/elem",
+          flush=True)
+    return t
+
+
+def chain(v, scalar):
+    return v * (1.0 + scalar * 1e-30)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--e", type=int, default=8_000_000)
+    ap.add_argument("--n", type=int, default=1_000_000)
+    args = ap.parse_args()
+    E, N = args.e, args.n
+    rng = np.random.default_rng(0)
+    print(f"device: {jax.devices()[0]}  E={E} N={N}  R={R}", flush=True)
+
+    vals0 = jnp.asarray(rng.standard_normal(E), jnp.float32)
+
+    # ---- scatter cost vs target size ------------------------------------
+    for n_t in (16_384, 65_536, 262_144, 1_048_576, 4_194_304):
+        tgt = jnp.asarray(rng.integers(0, n_t, size=E), jnp.int32)
+
+        def op(i, v, tgt=tgt, n_t=n_t):
+            out = jax.ops.segment_sum(v, tgt, num_segments=n_t)
+            return (chain(v, out[0]),)
+
+        bench(f"scatter E=8M -> target {n_t//1024}K rows", op, vals0,
+              nelem=E)
+
+    # ---- gather cost vs table size --------------------------------------
+    for n_t in (16_384, 65_536, 262_144, 1_048_576, 4_194_304):
+        table = jnp.asarray(rng.standard_normal(n_t), jnp.float32)
+        idx = jnp.asarray(rng.integers(0, n_t, size=E), jnp.int32)
+
+        def op(i, tb, idx=idx):
+            out = jnp.take(tb, idx)
+            return (chain(tb, out[0]),)
+
+        bench(f"gather E=8M from table {n_t//1024}K rows", op, table,
+              nelem=E)
+
+    # ---- sorted gather (the expand) -------------------------------------
+    share = jnp.asarray(rng.standard_normal(N), jnp.float32)
+    idx_sorted = jnp.sort(
+        jnp.asarray(rng.integers(0, N, size=E), jnp.int32))
+
+    def op_sg(i, tb):
+        out = jnp.take(tb, idx_sorted)
+        return (chain(tb, out[0]),)
+
+    bench("gather E=8M SORTED ids from 1M table", op_sg, share, nelem=E)
+
+    # jnp.repeat with static degrees, fixed total
+    deg = rng.integers(0, 16, size=N)
+    deg = (deg * (E / deg.sum())).astype(np.int64)
+    deg[0] += E - deg.sum()
+    deg_dev = jnp.asarray(deg, jnp.int32)
+
+    def op_rep(i, tb):
+        out = jnp.repeat(tb, deg_dev, total_repeat_length=E)
+        return (chain(tb, out[0]),)
+
+    bench("repeat 1M -> 8M (static total)", op_rep, share, nelem=E)
+
+    # ---- the candidate: scan of blocked scatters ------------------------
+    # messages pre-partitioned by dst block (static, build-time); equal
+    # block sizes by construction here (real builder pads)
+    for B in (4, 16, 64):
+        nb, eb = N // B, E // B
+        # dst within block b is any row of that block
+        loc = jnp.asarray(rng.integers(0, nb, size=(B, eb)), jnp.int32)
+        v_b = jnp.asarray(rng.standard_normal((B, eb)), jnp.float32)
+
+        def op_blk(i, v, loc=loc, nb=nb):
+            def body(carry, xs):
+                vv, ll = xs
+                out = jax.ops.segment_sum(vv, ll, num_segments=nb)
+                return carry + out[0], out
+            s, outs = jax.lax.scan(body, 0.0, (v, loc))
+            return (chain(v, s),)
+
+        bench(f"scan of {B} blocked scatters (target {nb//1024}K)",
+              op_blk, v_b, nelem=E)
+
+    # same but unrolled python loop (XLA sees static slices)
+    B = 16
+    nb, eb = N // B, E // B
+    loc = jnp.asarray(rng.integers(0, nb, size=(B, eb)), jnp.int32)
+    v_b = jnp.asarray(rng.standard_normal((B, eb)), jnp.float32)
+
+    def op_unroll(i, v):
+        s = 0.0
+        for b in range(B):
+            out = jax.ops.segment_sum(v[b], loc[b], num_segments=nb)
+            s = s + out[0]
+        return (chain(v, s),)
+
+    bench(f"unrolled {B} blocked scatters (target {nb//1024}K)",
+          op_unroll, v_b, nelem=E)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Probe the inter-pass regrouping options for the routed delivery.
+
+A routing pass emits, per input tile, B bucket runs that the next pass
+must read bucket-major.  Two candidate mechanisms:
+
+  (a) strided slab write: pallas output block (B, 1, CR, 128) over a
+      [B, T, CR, 128] staging array — each grid step writes B strided
+      chunks of CR*512 bytes; next pass reads contiguously.
+  (b) contiguous write [T, B, CR, 128] + one XLA transpose to
+      [B, T, CR, 128] between passes.
+
+Measures both at delivery scale.  Also probes the minor-dim class
+reduce (reshape [n, c] -> sum(-1)) the reduce stage relies on.
+
+Usage: python experiments/slab_probe.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+R = 32
+
+
+def sync(x):
+    return float(jax.device_get(jnp.sum(x.ravel()[:8].astype(jnp.float32))))
+
+
+def timed(fn, repeats=3):
+    fn()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench(name, op, nbytes, *carry):
+    @jax.jit
+    def run(*c):
+        return jax.lax.fori_loop(0, R, lambda i, c: op(i, *c), c)
+
+    t = timed(lambda: sync(run(*carry)[0])) / R
+    print(f"{name:52s} {t*1e3:9.3f} ms  {nbytes/t/1e9:6.1f} GB/s",
+          flush=True)
+    return t
+
+
+def main():
+    rng = np.random.default_rng(0)
+    print(f"device: {jax.devices()[0]}", flush=True)
+
+    # scale: ~8M f32 payload per pass (1M-node diffusion pair scale)
+    T, B, CR = 512, 102, 1  # T tiles in, B buckets, CR rows per (b, t)
+    x = jnp.asarray(rng.standard_normal((T, 128, 128)), jnp.float32)
+    nbytes = T * 128 * 128 * 8  # read + write
+
+    # (a) strided slab write from pallas
+    def slab_kernel(x_ref, o_ref):
+        tile = x_ref[0] * 2.0
+        # write the tile's rows as B runs of CR rows (first B*CR rows are
+        # real content here; the layout cost is what we measure)
+        o_ref[:, 0] = tile[: B * CR].reshape(B, 1, CR, 128)[:, 0]
+
+    slab = pl.pallas_call(
+        slab_kernel,
+        grid=(T,),
+        out_shape=jax.ShapeDtypeStruct((B, T, CR, 128), jnp.float32),
+        in_specs=[pl.BlockSpec((1, 128, 128), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((B, 1, CR, 128), lambda i: (0, i, 0, 0)),
+    )
+
+    try:
+        def op_a(i, v):
+            y = slab(v)
+            return (v * (1.0 + y[0, 0, 0, 0] * 1e-30),)
+        bench("pallas strided slab write (B,1,CR,128)", op_a, nbytes, x)
+    except Exception as ex:  # noqa: BLE001
+        print(f"slab write FAILED: {type(ex).__name__}: "
+              f"{str(ex).splitlines()[0][:160]}", flush=True)
+
+    # (b) contiguous write + XLA transpose
+    def contig_kernel(x_ref, o_ref):
+        o_ref[0] = (x_ref[0] * 2.0)[: B * CR].reshape(B, CR, 128)
+
+    contig = pl.pallas_call(
+        contig_kernel,
+        grid=(T,),
+        out_shape=jax.ShapeDtypeStruct((T, B, CR, 128), jnp.float32),
+        in_specs=[pl.BlockSpec((1, 128, 128), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, B, CR, 128), lambda i: (i, 0, 0, 0)),
+    )
+
+    def op_b(i, v):
+        y = contig(v)
+        z = jnp.transpose(y, (1, 0, 2, 3))
+        return (v * (1.0 + z[0, 0, 0, 0] * 1e-30),)
+
+    bench("pallas contig write + XLA transpose", op_b, nbytes * 2, x)
+
+    # minor-dim class reduce at delivery scale
+    for c in (8, 32, 128):
+        n = 8_000_000 // c
+        seg = jnp.asarray(rng.standard_normal((n, c)), jnp.float32)
+
+        def op_r(i, v):
+            s = jnp.sum(v, -1)
+            return (v * (1.0 + s[0] * 1e-30),)
+
+        bench(f"reshape [n,{c}] minor-dim sum", op_r, n * c * 4, seg)
+
+
+if __name__ == "__main__":
+    main()
