@@ -23,6 +23,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libgraphgen.so")
 _ASYNC_LIB_PATH = os.path.join(_NATIVE_DIR, "libasyncsim.so")
+_ROUTE_LIB_PATH = os.path.join(_NATIVE_DIR, "libroutecolor.so")
 
 _I64P = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
@@ -79,12 +80,52 @@ def _load_async() -> Optional[ctypes.CDLL]:
     return _load_shared(_ASYNC_LIB_PATH, _configure_asyncsim)
 
 
+def _configure_routecolor(lib: ctypes.CDLL) -> None:
+    lib.route_color_tiles.restype = ctypes.c_int64
+    lib.route_color_tiles.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, _I32P, _I32P, _I32P,
+    ]
+
+
+def _load_routecolor() -> Optional[ctypes.CDLL]:
+    return _load_shared(_ROUTE_LIB_PATH, _configure_routecolor)
+
+
 def available() -> bool:
     return _load() is not None
 
 
 def async_available() -> bool:
     return _load_async() is not None
+
+
+def routecolor_available() -> bool:
+    return _load_routecolor() is not None
+
+
+def route_color_tiles(
+    src_rows: np.ndarray, dst_rows: np.ndarray, n: int, deg: int
+) -> Optional[np.ndarray]:
+    """Batch Euler-split edge coloring (see ``native/routecolor.cpp``).
+
+    ``src_rows``/``dst_rows``: int32 ``[T, n*deg]`` row ids in ``[0, n)``
+    forming, per tile, a ``deg``-regular bipartite multigraph.  Returns a
+    proper coloring ``[T, n*deg]`` with colors in ``[0, deg)``, or None
+    when the native library is unavailable.
+    """
+    lib = _load_routecolor()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src_rows, dtype=np.int32)
+    dst = np.ascontiguousarray(dst_rows, dtype=np.int32)
+    tiles = int(np.prod(src.shape[:-1], dtype=np.int64)) if src.ndim > 1 else 1
+    color = np.empty_like(src)
+    rc = lib.route_color_tiles(
+        tiles, n, deg, src.reshape(-1), dst.reshape(-1), color.reshape(-1)
+    )
+    if rc != 0:
+        raise ValueError(f"route_color_tiles: malformed input (rc={rc})")
+    return color
 
 
 def _topo_csr64(topo):

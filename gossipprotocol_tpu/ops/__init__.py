@@ -1,0 +1,13 @@
+"""TPU-native data-movement ops: the routed delivery engine.
+
+Built on the measured fact (experiments/route_probe*.py) that XLA lowers
+every per-element index op to ~7 ns/element on this hardware while
+Pallas lane-gathers, transposes, and elementwise selects run at stream
+speed.  `clos` routes arbitrary [128,128]-tile permutations through
+those primitives; `plan` compiles an arbitrary static permutation into a
+radix pipeline of such tiles; `exec` runs it on device.  `delivery`
+(the user-facing piece) expresses push-sum/diffusion message delivery —
+`segment_sum` with static structure — as expand -> route -> reduce.
+"""
+
+from gossipprotocol_tpu.ops import clos, plan  # noqa: F401

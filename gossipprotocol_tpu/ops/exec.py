@@ -1,0 +1,152 @@
+"""Pallas executor for routing plans (see ``ops/plan.py``).
+
+Each stage is one ``pallas_call``: grid over input tiles, per tile O
+Clos permutations (3 lane-gathers + 2 transposes each — the only
+dynamic-index op Mosaic lowers fast on this hardware) and one strided
+slab write that lands every bucket run in its staging region.  The final
+pass K-merges each region into its exact output tile with masked
+selects; output slots with no flow read as zeros.
+
+``interpret=True`` runs the same kernels through the Pallas interpreter
+(used by the CPU test mesh); numerics are identical — the pipeline only
+moves f32 values, it never does arithmetic on them.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+from gossipprotocol_tpu.ops.plan import RoutePlan
+
+
+class DeviceStage(NamedTuple):
+    p: int
+    tau_in: int
+    b: int
+    cr: int
+    o: int
+    tau_slab: int
+    idx: jax.Array  # int8 [p*tau_in, o, 3, 128, 128]
+
+
+class DeviceFinal(NamedTuple):
+    k: int
+    idx: jax.Array   # int8 [nt_out, k, 3, 128, 128]
+    mask: jax.Array  # uint8 [nt_out, k, 128, 128]
+
+
+class DevicePlan(NamedTuple):
+    """RoutePlan with tables on device — build once, apply every round."""
+
+    unit: int
+    nt_in: int
+    nt_out: int
+    stages: Tuple[DeviceStage, ...]
+    final: DeviceFinal
+
+    @property
+    def m_in_f32(self) -> int:
+        return self.nt_in * 128 * 128
+
+    @property
+    def m_out_f32(self) -> int:
+        return self.nt_out * 128 * 128
+
+
+def device_plan(plan: RoutePlan) -> DevicePlan:
+    stages = tuple(
+        DeviceStage(st.p, st.tau_in, st.b, st.cr, st.o, st.tau_slab,
+                    jnp.asarray(st.idx))
+        for st in plan.stages)
+    fin = DeviceFinal(plan.final.k, jnp.asarray(plan.final.idx),
+                      jnp.asarray(plan.final.mask))
+    return DevicePlan(plan.unit, plan.nt_in, plan.nt_out, stages, fin)
+
+
+def _route_one(x, i1, i2, i3):
+    a = jnp.take_along_axis(x, i1.astype(jnp.int32), axis=1)
+    b = jnp.take_along_axis(a.T, i2.astype(jnp.int32), axis=1)
+    return jnp.take_along_axis(b.T, i3.astype(jnp.int32), axis=1)
+
+
+def _stage_call(st: DeviceStage, cur: jax.Array, interpret: bool):
+    o_count, b, cr = st.o, st.b, st.cr
+
+    def kernel(x_ref, idx_ref, o_ref):
+        x = x_ref[0]
+        parts = [
+            _route_one(x, idx_ref[0, oi, 0], idx_ref[0, oi, 1],
+                       idx_ref[0, oi, 2])
+            for oi in range(o_count)
+        ]
+        rows = jnp.concatenate(parts, 0)[: b * cr]
+        o_ref[0, :, 0] = rows.reshape(b, cr, 128)
+
+    out_shape = jax.ShapeDtypeStruct(
+        (st.p, st.b, st.tau_slab, st.cr, 128), cur.dtype)
+    tau = st.tau_in
+    staging = pl.pallas_call(
+        kernel,
+        grid=(st.p, tau),
+        out_shape=out_shape,
+        in_specs=[
+            pl.BlockSpec((1, 128, 128), lambda p, i: (p * tau + i, 0, 0)),
+            pl.BlockSpec((1, o_count, 3, 128, 128),
+                         lambda p, i: (p * tau + i, 0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, st.b, 1, st.cr, 128),
+                               lambda p, i: (p, 0, i, 0, 0)),
+        interpret=interpret,
+    )(cur, st.idx)
+    return staging.reshape(-1, 128, 128)
+
+
+def _final_call(fin: DeviceFinal, nt_out: int, cur: jax.Array,
+                interpret: bool):
+    k = fin.k
+    regions = cur.reshape(-1, k, 128, 128)
+
+    def kernel(x_ref, idx_ref, m_ref, o_ref):
+        acc = jnp.zeros((128, 128), cur.dtype)
+        for kk in range(k):
+            y = _route_one(x_ref[0, kk], idx_ref[0, kk, 0],
+                           idx_ref[0, kk, 1], idx_ref[0, kk, 2])
+            acc = jnp.where(m_ref[0, kk] != 0, y, acc)
+        o_ref[0] = acc
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nt_out,),
+        out_shape=jax.ShapeDtypeStruct((nt_out, 128, 128), cur.dtype),
+        in_specs=[
+            pl.BlockSpec((1, k, 128, 128), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, k, 3, 128, 128), lambda i: (i, 0, 0, 0, 0)),
+            pl.BlockSpec((1, k, 128, 128), lambda i: (i, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 128, 128), lambda i: (i, 0, 0)),
+        interpret=interpret,
+    )(regions, fin.idx, fin.mask)
+
+
+def apply_plan(dp: DevicePlan, x: jax.Array, interpret: bool = False
+               ) -> jax.Array:
+    """Route a flat f32 array through the plan.
+
+    ``x``: f32 ``[nt_in*16384]`` (pad with anything up to tile size).
+    Returns f32 ``[nt_out*16384]``; slots compiled as don't-care are 0.
+
+    Not jitted itself (plan geometry drives python control flow); call it
+    inside the caller's ``jit`` — the DevicePlan arrays close over as
+    constants-on-device.
+    """
+    cur = x.reshape(dp.nt_in, 128, 128)
+    for st in dp.stages:
+        cur = _stage_call(st, cur, interpret)
+    out = _final_call(dp.final, dp.nt_out, cur, interpret)
+    return out.reshape(-1)

@@ -1,0 +1,258 @@
+"""Routing-plan compiler: a static permutation as a radix pipeline.
+
+Given a build-time-known permutation of M value units (unit = 1 f32 or a
+2-lane (s, w) pair), produce a plan of stream-speed passes the Pallas
+executor (``ops/exec.py``) can run every round:
+
+    stage 1..L   each input [128,128] tile applies one Clos tile
+                 permutation (``ops/clos.py``) that groups its units by
+                 the next radix digit of their destination tile, then
+                 writes per-bucket runs into a strided staging slab.
+                 After stage l every unit sits in a contiguous staging
+                 *region* shared only with units whose final tile agrees
+                 on the first l digits.
+    final pass   each final tile's region (K stacked tiles, capacity
+                 padding included) is merged by K masked Clos perms into
+                 the exact output tile.
+
+All capacities are computed from the **actual** per-(tile, bucket)
+counts — there is no probabilistic padding and no overflow: CR (rows per
+run) is the exact max, rounded up to a power-of-two divisor of 128 so
+regions stay 128-row aligned.
+
+Conventions
+-----------
+``src_of``: int64 ``[M_out]`` (unit granularity).  ``src_of[k] = s`` means
+output unit slot ``k`` receives input unit ``s``; ``-1`` marks an output
+slot whose value is never read downstream (tile-padding tail).  Real
+entries must be distinct (injective).  Slots that *are* read but should
+be zero (class padding in the delivery layouts) must instead map to
+zero-valued input slots — the router moves values, it never makes them.
+
+Measured context: every XLA per-element index op on this rig costs
+~7 ns/element (experiments/route_probe2.py) while the tile-perm kernel
+runs at 0.52 ns/element (experiments/tile_perm_probe.py); this compiler
+exists to turn `segment_sum`-shaped delivery into the latter.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Tuple
+
+import numpy as np
+
+from gossipprotocol_tpu.ops import clos
+
+TILE = clos.TILE  # 16384 f32 slots
+
+
+class StagePass(NamedTuple):
+    """One radix-distribution pass (geometry + routing tables)."""
+
+    p: int            # regions at this stage's input
+    tau_in: int       # input tiles per region
+    b: int            # buckets (radix) per region
+    cr: int           # rows per (input tile, bucket) run — pow2, | 128
+    o: int            # stacked output tiles routed per input tile
+    tau_slab: int     # slab Tin-axis length (tau_in padded for alignment)
+    idx: np.ndarray   # int8 [p*tau_in, o, 3, 128, 128]
+
+
+class FinalPass(NamedTuple):
+    k: int            # stacked input tiles per final region
+    idx: np.ndarray   # int8 [nt_out, k, 3, 128, 128]
+    mask: np.ndarray  # uint8 [nt_out, k, 128, 128] — source-k selector
+
+
+class RoutePlan(NamedTuple):
+    unit: int
+    u: int            # units per tile
+    nt_in: int
+    nt_out: int
+    stages: Tuple[StagePass, ...]
+    final: FinalPass
+
+    @property
+    def m_in(self) -> int:
+        return self.nt_in * self.u
+
+    @property
+    def m_out(self) -> int:
+        return self.nt_out * self.u
+
+
+def _pow2_cr(rows: int) -> int:
+    """Round run rows up to a power of two (<= 128) so runs divide 128."""
+    cr = 1
+    while cr < rows:
+        cr *= 2
+    if cr > 128:
+        raise ValueError(f"run of {rows} rows exceeds one tile")
+    return cr
+
+
+def _complete_bijections(perm: np.ndarray, u: int) -> np.ndarray:
+    """Fill -1 slots of each row so every row is a bijection of [0, u).
+
+    ``perm``: int64 [R, u] with real entries distinct per row.  The fill
+    pairs each row's unused sources with its -1 slots in order.
+    """
+    r, width = perm.shape
+    assert width == u
+    out = perm.copy()
+    used = np.zeros((r, u), bool)
+    rows = np.repeat(np.arange(r), u)
+    real = out >= 0
+    used[rows.reshape(r, u)[real], out[real]] = True
+    # rank unused sources and unfilled slots per row, match by rank
+    free_src = ~used
+    slot_rank = np.cumsum(~real, axis=1) - 1       # rank among -1 slots
+    src_rank = np.cumsum(free_src, axis=1) - 1     # rank among free sources
+    # build per-row list of free sources ordered by source id
+    free_counts = free_src.sum(1)
+    assert (free_counts == (~real).sum(1)).all(), "perm rows not injective"
+    # gather: for each row, free_sources[rank] — vectorized via argsort
+    # position of the j-th free source: use cumcount inversion
+    src_ids = np.broadcast_to(np.arange(u), (r, u))
+    # table[row, rank] = source id
+    table = np.full((r, u), -1, np.int64)
+    table[np.broadcast_to(np.arange(r)[:, None], (r, u))[free_src],
+          src_rank[free_src]] = src_ids[free_src]
+    out[~real] = table[np.broadcast_to(np.arange(r)[:, None], (r, u))[~real],
+                       slot_rank[~real]]
+    return out
+
+
+def build_route_plan(src_of: np.ndarray, m_in: int, unit: int = 2,
+                     progress=None) -> RoutePlan:
+    """Compile the permutation into a radix pipeline plan."""
+    src_of = np.asarray(src_of, np.int64)
+    u = TILE // unit
+    nt_out = max(1, -(-len(src_of) // u))
+    nt_in = max(1, -(-m_in // u))
+    m_out_pad = nt_out * u
+    if len(src_of) < m_out_pad:
+        src_of = np.concatenate(
+            [src_of, np.full(m_out_pad - len(src_of), -1, np.int64)])
+
+    real = np.nonzero(src_of >= 0)[0]          # output slots with a flow
+    pos = src_of[real].copy()                  # current position of flows
+    ft = real // u                             # final tile of each flow
+    if real.size:
+        counts = np.bincount(src_of[real], minlength=nt_in * u)
+        if counts.max(initial=0) > 1:
+            raise ValueError("src_of is not injective on real slots")
+
+    stages: List[StagePass] = []
+    p_regions, tau_in, span = 1, nt_in, nt_out
+    stage_no = 0
+    while span > 1:
+        stage_no += 1
+        b = min(128, span)
+        span_next = -(-span // b)
+        # flow coordinates at this stage
+        tile = pos // u                        # global input tile
+        reg = tile // tau_in                   # region (= first digits)
+        ft_rel = ft - reg * span
+        bucket = ft_rel // span_next
+        if (bucket < 0).any() or (bucket >= b).any():
+            raise AssertionError("bucket out of range (compiler bug)")
+        # run packing: order flows by (tile, bucket), rank within run
+        order = np.lexsort((pos, bucket, tile))
+        tile_o, bucket_o, pos_o = tile[order], bucket[order], pos[order]
+        key = tile_o * b + bucket_o
+        run_start = np.r_[0, np.nonzero(np.diff(key))[0] + 1]
+        run_len = np.diff(np.r_[run_start, key.size])
+        rank = np.arange(key.size) - np.repeat(run_start, run_len)
+        upr = 128 // unit
+        max_rows = int(-(-run_len.max() // upr)) if key.size else 1
+        cr = _pow2_cr(max_rows)
+        o = -(-b * cr // 128)
+        tau_slab = -(-(tau_in * cr) // 128) * (128 // cr)
+        # output stacked-slot of each flow within its input tile's o tiles
+        out_row = bucket_o * cr + rank // upr
+        out_slot = out_row * upr + rank % upr   # unit slot in [0, o*u)
+        # new global position in the staging layout
+        # staging rows: ((reg*b + bucket)*tau_slab + tile_in_reg)*cr + row
+        tile_in_reg = tile_o - (tile_o // tau_in) * tau_in
+        reg_o = tile_o // tau_in
+        g_row = (((reg_o * b + bucket_o) * tau_slab + tile_in_reg) * cr
+                 + rank // upr)
+        new_pos = g_row * upr + rank % upr
+        # per-(tile, o) bijections
+        t_grid = p_regions * tau_in
+        perm = np.full((t_grid * o, u), -1, np.int64)
+        which_o = out_slot // u
+        perm[tile_o * o + which_o, out_slot % u] = pos_o % u
+        perm = _complete_bijections(perm, u)
+        if progress:
+            progress(f"stage {stage_no}: routing {t_grid * o} tile perms")
+        i1, i2, i3 = clos.route_tile_perms(perm, unit=unit)
+        idx = np.stack([i1, i2, i3], axis=1).reshape(
+            t_grid, o, 3, 128, 128)
+        stages.append(StagePass(p_regions, tau_in, b, cr, o, tau_slab, idx))
+        # advance flow positions (undo the sort)
+        pos[order] = new_pos
+        p_regions *= b
+        tau_in = tau_slab * cr // 128
+        span = span_next
+
+    # final pass: region r holds exactly final tile r's flows
+    k = tau_in
+    tile = pos // u
+    reg = tile // k
+    if real.size and not (reg == ft).all():
+        raise AssertionError("flows not in their final region (bug)")
+    perm = np.full((nt_out * k, u), -1, np.int64)
+    stacked = tile - reg * k                   # which of the K inputs
+    perm[ft * k + stacked, real % u] = pos % u
+    perm = _complete_bijections(perm, u)
+    if progress:
+        progress(f"final: routing {nt_out * k} tile perms")
+    i1, i2, i3 = clos.route_tile_perms(perm, unit=unit)
+    idx = np.stack([i1, i2, i3], axis=1).reshape(nt_out, k, 3, 128, 128)
+    mask = np.zeros((nt_out, k, 128, 128), np.uint8)
+    fr = (real % u) * unit // 128              # final slot f32 row
+    fc = (real % u) * unit % 128
+    for j in range(unit):
+        mask[ft, stacked, fr, fc + j] = 1
+    return RoutePlan(unit, u, nt_in, nt_out, tuple(stages),
+                     FinalPass(k, idx, mask))
+
+
+# --------------------------------------------------------------------------
+# host reference executor (numpy) — the exactness oracle for the kernels
+# --------------------------------------------------------------------------
+
+def apply_plan_np(plan: RoutePlan, x: np.ndarray) -> np.ndarray:
+    """Run the pipeline in numpy; returns the routed f32 array.
+
+    ``x``: f32 [nt_in*TILE] (f32 granularity).  Output slots marked -1 at
+    compile time hold unspecified values.
+    """
+    x = np.asarray(x, np.float32).reshape(plan.nt_in, 128, 128)
+    cur = x
+    for st in plan.stages:
+        t_grid = st.p * st.tau_in
+        slab = np.zeros((st.p * st.b * st.tau_slab * st.cr, 128), np.float32)
+        for t in range(t_grid):
+            parts = []
+            for o_i in range(st.o):
+                i1, i2, i3 = st.idx[t, o_i]
+                parts.append(clos.apply_route_np(cur[t], i1, i2, i3))
+            rows = np.concatenate(parts, 0)[: st.b * st.cr]
+            reg, i = t // st.tau_in, t % st.tau_in
+            for bb in range(st.b):
+                base = ((reg * st.b + bb) * st.tau_slab + i) * st.cr
+                slab[base: base + st.cr] = rows[bb * st.cr:(bb + 1) * st.cr]
+        cur = slab.reshape(-1, 128, 128)
+    fin = plan.final
+    out = np.zeros((plan.nt_out, 128, 128), np.float32)
+    for ftile in range(plan.nt_out):
+        acc = np.zeros((128, 128), np.float32)
+        for kk in range(fin.k):
+            i1, i2, i3 = fin.idx[ftile, kk]
+            y = clos.apply_route_np(cur[ftile * fin.k + kk], i1, i2, i3)
+            acc = np.where(fin.mask[ftile, kk].astype(bool), y, acc)
+        out[ftile] = acc
+    return out.reshape(-1)

@@ -1,0 +1,128 @@
+// Euler-split edge coloring for the routed-delivery plan compiler.
+//
+// The TPU delivery kernel (gossipprotocol_tpu/ops/) applies an arbitrary
+// static permutation to [128,128] tiles as three lane-gathers and two
+// transposes (3-stage Clos).  Routing a tile permutation through that
+// network is exactly a proper edge coloring of the n-regular bipartite
+// multigraph  src_row -> dst_row  with n colors (Konig).  This file
+// implements the classic Euler-split construction: repeatedly orient an
+// Euler circuit and split the edges into two d/2-regular halves until
+// each leaf is a perfect matching, which gets one color.  O(E log n)
+// per tile; n must be a power of two.
+//
+// The numpy fallback in gossipprotocol_tpu/ops/routing.py implements the
+// same algorithm; tests assert both produce proper colorings (colors are
+// not required to match bit-for-bit — any proper coloring routes).
+//
+// Exposed C ABI:
+//   route_color_tiles(T, n, deg, src, dst, color)
+//     T      : number of tiles
+//     n      : switch width (colors); left/right vertices are n rows
+//     deg    : per-row degree (= edges per tile / n), power of two
+//     src,dst: int32[T * n * deg]  row ids in [0, n)
+//     color  : int32[T * n * deg]  out, in [0, deg)
+//   returns 0 on success, nonzero on malformed input.
+
+#include <cstdint>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+struct Splitter {
+  int n;                       // vertices per side
+  const int32_t* src;          // tile-local edge arrays
+  const int32_t* dst;
+  int32_t* color;
+  std::vector<int32_t> head_;  // incidence list heads, 2n vertices
+  std::vector<int32_t> nxt_;   // next incidence entry (2 per edge)
+  std::vector<int32_t> stack_;
+  std::vector<uint8_t> used_;
+  std::vector<int32_t> half_[2];
+
+  // Orient Euler circuits of the edge set `ids` (degree d, even) and
+  // split into two halves of degree d/2 each.
+  void split(std::vector<int32_t>& ids, int d, int c0, int nc) {
+    if (d == 1) {
+      for (int32_t e : ids) color[e] = c0;
+      return;
+    }
+    const int E = static_cast<int>(ids.size());
+    head_.assign(2 * n, -1);
+    nxt_.resize(2 * E);
+    // incidence entry 2k   = edge ids[k] seen from its left vertex
+    // incidence entry 2k+1 = edge ids[k] seen from its right vertex
+    for (int k = 0; k < E; ++k) {
+      const int32_t e = ids[k];
+      const int u = src[e];
+      const int v = n + dst[e];
+      nxt_[2 * k] = head_[u];
+      head_[u] = 2 * k;
+      nxt_[2 * k + 1] = head_[v];
+      head_[v] = 2 * k + 1;
+    }
+    used_.assign(E, 0);
+    half_[0].clear();
+    half_[1].clear();
+    half_[0].reserve(E / 2);
+    half_[1].reserve(E / 2);
+    // Hierholzer over every component; all degrees even by regularity.
+    for (int start = 0; start < 2 * n; ++start) {
+      if (head_[start] < 0) continue;
+      stack_.clear();
+      stack_.push_back(start);
+      int prev_side = 0;  // alternation within one trail
+      while (!stack_.empty()) {
+        const int vtx = stack_.back();
+        int ent = head_[vtx];
+        while (ent >= 0 && used_[ent >> 1]) ent = nxt_[ent];
+        head_[vtx] = ent;  // path compression over used entries
+        if (ent < 0) {
+          stack_.pop_back();
+          continue;
+        }
+        const int k = ent >> 1;
+        used_[k] = 1;
+        // direction: entry parity says which side we are leaving from
+        const bool from_left = (ent & 1) == 0;
+        half_[from_left ? 0 : 1].push_back(ids[k]);
+        (void)prev_side;
+        const int32_t e = ids[k];
+        const int other = from_left ? n + dst[e] : src[e];
+        stack_.push_back(other);
+      }
+    }
+    std::vector<int32_t> a;
+    a.swap(half_[0]);
+    std::vector<int32_t> b;
+    b.swap(half_[1]);
+    split(a, d / 2, c0, nc / 2);
+    split(b, d / 2, c0 + nc / 2, nc / 2);
+  }
+};
+
+}  // namespace
+
+extern "C" int64_t route_color_tiles(int64_t T, int32_t n, int32_t deg,
+                                     const int32_t* src, const int32_t* dst,
+                                     int32_t* color) {
+  if (n <= 0 || deg <= 0 || (deg & (deg - 1)) != 0) return 1;
+  const int64_t epr = static_cast<int64_t>(n) * deg;  // edges per tile
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 8)
+#endif
+  for (int64_t t = 0; t < T; ++t) {
+    Splitter s;
+    s.n = n;
+    s.src = src + t * epr;
+    s.dst = dst + t * epr;
+    s.color = color + t * epr;
+    std::vector<int32_t> ids(epr);
+    for (int64_t k = 0; k < epr; ++k) ids[k] = static_cast<int32_t>(k);
+    s.split(ids, deg, 0, deg);
+  }
+  return 0;
+}
