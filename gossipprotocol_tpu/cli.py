@@ -150,14 +150,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "fanout-all diffusion variant that converges at "
                         "graph mixing time (required for hub-heavy graphs "
                         "like power-law at scale)")
-    p.add_argument("--delivery", choices=["scatter", "invert"],
+    p.add_argument("--delivery", choices=["scatter", "invert", "routed"],
                    default="scatter",
-                   help="push-sum fanout-one delivery: segment_sum "
-                        "scatter-add, or the receiver-side gather inversion "
-                        "(single-chip, bounded-degree, no faults; "
-                        "trajectories agree to float accumulation order; "
-                        "measured 9x slower on TPU v5e — a validated "
-                        "negative result, see README)")
+                   help="push-sum delivery. fanout-one: segment_sum "
+                        "scatter-add, or 'invert' — the receiver-side "
+                        "gather inversion (single-chip, bounded-degree, no "
+                        "faults; measured 9x slower on TPU v5e, a validated "
+                        "negative result, see README). fanout-all: "
+                        "'routed' replaces the per-edge scatters with "
+                        "static Clos routing plans (single-chip, f32, "
+                        "component-closed dead sets; trajectories agree "
+                        "with scatter to float accumulation order; "
+                        "measured ~7x faster at 10M power-law)")
     p.add_argument("--value-mode", choices=["scaled", "index"], default="scaled",
                    help="push-sum init: i/N (TPU-safe) or the reference's s_i=i")
     p.add_argument("--x64", action="store_true",
@@ -328,6 +332,12 @@ def main(argv=None) -> int:
                     "delivery='invert' is single-chip only — drop --devices "
                     "or use delivery='scatter'"
                 )
+        if cfg.delivery == "routed" and args.devices > 1:
+            raise ValueError(
+                "delivery='routed' is single-chip only (the routing plans "
+                "address one chip's HBM) — drop --devices or use "
+                "delivery='scatter'"
+            )
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2

@@ -117,8 +117,28 @@ class RunConfig:
                 "single-target send IS the reference's accidental behavior "
                 "(Program.fs:128) that the diffusion variant replaces"
             )
-        if self.delivery not in ("scatter", "invert"):
-            raise ValueError("delivery must be 'scatter' or 'invert'")
+        if self.delivery not in ("scatter", "invert", "routed"):
+            raise ValueError("delivery must be 'scatter', 'invert', or "
+                             "'routed'")
+        if self.delivery == "routed":
+            if self.algorithm != "push-sum" or self.fanout != "all":
+                raise ValueError(
+                    "delivery='routed' applies to fanout-all diffusion "
+                    "only (the static edge structure is what the routing "
+                    "plan compiles; single-target draws fresh targets "
+                    "every round — see README 'Performance')"
+                )
+            if self.fault_plan:
+                raise ValueError(
+                    "delivery='routed' is exact only while the dead set "
+                    "is component-closed (dead senders ship zero mass); "
+                    "drop the fault plan or use delivery='scatter'"
+                )
+            if jnp.dtype(self.dtype) != jnp.float32:
+                raise ValueError(
+                    "delivery='routed' routes f32 lane pairs; use "
+                    "delivery='scatter' for float64 runs"
+                )
         if self.delivery == "invert":
             if self.algorithm != "push-sum" or self.fanout != "one":
                 raise ValueError(
@@ -156,7 +176,11 @@ class RunConfig:
             return self.chunk_rounds
         per_round_s = max(num_nodes, 1) * 100e-9
         if self.algorithm == "push-sum" and self.fanout == "all":
-            per_round_s += (num_edges or 0) * 65e-9
+            # routed delivery replaces the per-edge random scatter with
+            # stream-speed routing passes (measured ~6 ns/pair + class
+            # overhead, experiments/route_bench.py)
+            per_edge = 12e-9 if self.delivery == "routed" else 65e-9
+            per_round_s += (num_edges or 0) * per_edge
         if jnp.dtype(self.dtype) == jnp.float64:
             per_round_s *= 16
         # the >=4 floor only amortizes dispatch overhead; when single
@@ -302,10 +326,20 @@ def build_protocol(
         if cfg.fanout == "all":
             from gossipprotocol_tpu.protocols.diffusion import (
                 pushsum_diffusion_round,
+                pushsum_diffusion_round_routed,
             )
 
+            if cfg.delivery == "routed" and not targets_alive:
+                raise ValueError(
+                    "delivery='routed' is exact only while the dead set "
+                    "is component-closed (no fault plan, no resumed "
+                    "arbitrary dead set) — use delivery='scatter'"
+                )
+            round_fn = (pushsum_diffusion_round_routed
+                        if cfg.delivery == "routed"
+                        else pushsum_diffusion_round)
             core = partial(
-                pushsum_diffusion_round,
+                round_fn,
                 n=n,
                 eps=cfg.eps,
                 streak_target=cfg.streak_target,
@@ -408,6 +442,10 @@ def device_arrays(topo: Topology, cfg: RunConfig):
     reverse-slot inversion tables for dense gossip), the edge list for
     fanout-all diffusion (which draws nothing and walks every edge)."""
     if cfg.algorithm == "push-sum" and cfg.fanout == "all":
+        if cfg.delivery == "routed":
+            from gossipprotocol_tpu.ops.delivery import build_routed_delivery
+
+            return build_routed_delivery(topo)
         from gossipprotocol_tpu.protocols.diffusion import diffusion_edges
 
         return diffusion_edges(topo)

@@ -1,0 +1,253 @@
+"""Routed message delivery: ``segment_sum`` with static structure, rebuilt
+as expand -> route -> reduce at stream speed.
+
+The fanout-all diffusion round (``protocols/diffusion.py``, the op behind
+``Program.fs:128``'s capability at scale) spends ~95 % of its time in two
+`segment_sum` scatter-adds whose uniform-random segment ids XLA lowers to
+~7 ns/element serialized updates (measured, experiments/route_probe2.py).
+Because the edge list is *static*, the same delivery is a build-time-known
+permutation of per-edge values — and ops/plan.py turns any static
+permutation into stream-speed Pallas passes (6 ns/pair measured for the
+full pipeline vs ~14+ ns/pair for the two scatters, worse at 10M).
+
+Pipeline per round (all f32, (s, w) routed together as lane pairs):
+
+  1. plan_in   : state pairs, natural node order -> degree-class order
+                 (class = ceil-pow2 of degree; nodes grouped by class so
+                 the expand and reduce are pure reshapes)
+  2. expand    : per class c, broadcast each node pair to its c slots;
+                 multiply by the static real-slot mask (padding slots of
+                 a node with degree d < c carry zero)
+  3. plan_m    : the edge permutation — out-slot (u, k) of edge u->v
+                 lands in in-slot (v, rank of v->u) — class pads map to
+                 zero-valued pads, so every delivered value is real
+  4. reduce    : per class c, reshape [n_c, c, 2] and sum the slot axis
+  5. plan_out  : class order -> natural order; degree-0 nodes (and state
+                 padding rows) read exact zeros (don't-care slots)
+
+Fault legality matches the inverted gossip delivery: exact under the
+engine's ``all_alive`` / ``targets_alive`` regimes (component-closed dead
+sets — a dead node's shares are zeroed at the sender, and zero mass
+delivers zero), rejected for arbitrary mid-run fault plans.
+Accumulation order differs from `segment_sum` (tree-of-pairs per class
+vs scatter order), so trajectories agree to float accumulation order —
+the same contract as ``delivery='invert'`` (README "Performance").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu.ops import plan as plan_mod
+from gossipprotocol_tpu.ops import exec as exec_mod
+from gossipprotocol_tpu.ops.exec import (
+    DevicePlan, DeviceStage, DeviceFinal, apply_plan, device_plan,
+)
+from gossipprotocol_tpu.topology.base import Topology
+
+TILE = 128 * 128
+
+
+def _ceil_pow2(x: np.ndarray) -> np.ndarray:
+    x = np.maximum(x, 1)
+    return (1 << np.ceil(np.log2(x)).astype(np.int64)).astype(np.int64)
+
+
+# --- pytree registration: geometry static, tables dynamic ----------------
+
+def _register():
+    def stage_flatten(s):
+        return (s.idx,), (s.p, s.tau_in, s.b, s.cr, s.o, s.tau_slab)
+
+    def stage_unflatten(aux, children):
+        return DeviceStage(*aux[:6], children[0])
+
+    def final_flatten(f):
+        return (f.idx, f.mask), (f.k,)
+
+    def final_unflatten(aux, children):
+        return DeviceFinal(aux[0], *children)
+
+    def plan_flatten(p):
+        return ((p.stages, p.final),), (p.unit, p.nt_in, p.nt_out)
+
+    def plan_unflatten(aux, children):
+        stages, final = children[0]
+        return DevicePlan(aux[0], aux[1], aux[2], stages, final)
+
+    jax.tree_util.register_pytree_node(
+        DeviceStage, stage_flatten, stage_unflatten)
+    jax.tree_util.register_pytree_node(
+        DeviceFinal, final_flatten, final_unflatten)
+    jax.tree_util.register_pytree_node(
+        DevicePlan, plan_flatten, plan_unflatten)
+
+
+_register()
+
+
+def _register_delivery():
+    def flatten(r):
+        return ((r.plan_in, r.plan_m, r.plan_out, r.realmask, r.degree),
+                (r.n, r.nu, r.m_pairs, r.classes))
+
+    def unflatten(aux, children):
+        return RoutedDelivery(aux[0], aux[1], aux[2], aux[3], *children)
+
+    jax.tree_util.register_pytree_node(RoutedDelivery, flatten, unflatten)
+
+
+class RoutedDelivery(NamedTuple):  # registered below: geometry static
+    """Device-side routed delivery for one topology (a pytree)."""
+
+    n: int                       # real nodes
+    nu: int                      # nodes with degree > 0
+    m_pairs: int                 # class-layout pair slots
+    classes: Tuple[Tuple[int, int, int], ...]  # (c, n_c, start_pair)
+    plan_in: DevicePlan
+    plan_m: DevicePlan
+    plan_out: DevicePlan
+    realmask: jax.Array          # f32 [m_pairs] 1.0 on real slots
+    degree: jax.Array            # int32 [n]
+
+    def matvec(self, xs: jax.Array, xw: jax.Array, interpret: bool = False):
+        """(in_s, in_w)[i] = sum over neighbors j of (xs, xw)[j].
+
+        Inputs may carry engine padding rows beyond ``n`` (ignored — pad
+        rows have no edges); outputs are zero there.
+        """
+        rows = xs.shape[0]
+        pairs = jnp.stack([xs[: self.n], xw[: self.n]], -1).reshape(-1)
+        pad = self.plan_in.m_in_f32 - pairs.shape[0]
+        cls = apply_plan(self.plan_in, jnp.pad(pairs, (0, pad)),
+                         interpret)[: self.nu * 2].reshape(self.nu, 2)
+        segs = []
+        off = 0
+        for c, n_c, start in self.classes:
+            seg = jax.lax.dynamic_slice_in_dim(cls, off, n_c, 0)
+            segs.append(jnp.broadcast_to(
+                seg[:, None, :], (n_c, c, 2)).reshape(-1, 2))
+            off += n_c
+        e1 = jnp.concatenate(segs, 0) * self.realmask[:, None]
+        e1f = e1.reshape(-1)
+        pad = self.plan_m.m_in_f32 - e1f.shape[0]
+        routed = apply_plan(self.plan_m, jnp.pad(e1f, (0, pad)),
+                            interpret)[: self.m_pairs * 2]
+        f = routed.reshape(self.m_pairs, 2)
+        ys = []
+        for c, n_c, start in self.classes:
+            seg = jax.lax.dynamic_slice_in_dim(f, start, n_c * c, 0)
+            ys.append(seg.reshape(n_c, c, 2).sum(1))
+        yf = jnp.concatenate(ys, 0).reshape(-1)
+        pad = self.plan_out.m_in_f32 - yf.shape[0]
+        nat = apply_plan(self.plan_out, jnp.pad(yf, (0, pad)),
+                         interpret)[: self.n * 2].reshape(self.n, 2)
+        if rows > self.n:
+            nat = jnp.pad(nat, ((0, rows - self.n), (0, 0)))
+        return nat[:, 0], nat[:, 1]
+
+
+_register_delivery()
+
+
+def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
+    """Compile the three routing plans for a topology (host, one-time).
+
+    Cites the capability source: the reference's push-sum send
+    (``Program.fs:128``) — here generalized to the fanout-all diffusion
+    delivery the north-star configs need at 10M nodes.
+    """
+    if topo.implicit_full:
+        raise ValueError("routed delivery: complete graph needs no edges "
+                         "(diffusion mixes in one round via reductions)")
+    n = topo.num_nodes
+    offsets = np.asarray(topo.offsets, np.int64)
+    indices = np.asarray(topo.indices, np.int64)
+    degree = np.diff(offsets)
+    cls = _ceil_pow2(degree)
+    cls[degree == 0] = 0
+
+    # class-major node order (stable -> deterministic)
+    order = np.argsort(np.where(cls == 0, np.iinfo(np.int64).max, cls),
+                       kind="stable")
+    nu = int((degree > 0).sum())
+    order = order[:nu]                       # degree-0 nodes excluded
+    rank = np.full(n, -1, np.int64)
+    rank[order] = np.arange(nu)
+
+    c_sorted = cls[order]
+    # per-node slot starts in the class layout
+    slot_count = c_sorted
+    starts = np.r_[0, np.cumsum(slot_count)]
+    m_pairs = int(starts[-1])
+
+    # class segment table (c, n_c, start_pair)
+    classes = []
+    i = 0
+    while i < nu:
+        c = int(c_sorted[i])
+        j = i
+        while j < nu and c_sorted[j] == c:
+            j += 1
+        classes.append((c, j - i, int(starts[i])))
+        i = j
+    classes = tuple(classes)
+
+    if progress:
+        progress(f"routed delivery: n={n} nu={nu} m_pairs={m_pairs} "
+                 f"classes={[(c, k) for c, k, _ in classes]}")
+
+    # ---- plan_in: natural -> class order --------------------------------
+    src_in = order.copy()                    # out slot k <- node order[k]
+    plan_in = plan_mod.build_route_plan(src_in, m_in=n, unit=2,
+                                        progress=progress)
+
+    # ---- plan_m: edge permutation on the class layout -------------------
+    # directed edge e (row u, slot k): E1 slot = starts[rank[u]] + k
+    # its value lands at (v, rank of reverse edge v->u in v's row)
+    src_nodes = np.repeat(np.arange(n, dtype=np.int64), degree)
+    e1_slot = starts[rank[src_nodes]] + (
+        np.arange(len(indices), dtype=np.int64) - offsets[src_nodes])
+    # reverse-edge rank: position of (v, u) in v's row, via lexsort pairing
+    fwd = np.lexsort((indices, src_nodes))   # sorted (u, v) — CSR is sorted
+    rev = np.lexsort((src_nodes, indices))   # sorted (v, u)
+    # edge (u->v) pairs with edge (v->u): the i-th entry of fwd-sorted
+    # (u,v) equals the i-th entry of rev-sorted (v,u) swapped
+    reverse_of = np.empty(len(indices), np.int64)
+    reverse_of[fwd] = rev
+    in_rank = np.empty(len(indices), np.int64)
+    in_rank[reverse_of] = np.arange(len(indices)) - offsets[src_nodes]
+    f_slot = starts[rank[indices]] + in_rank
+    src_of_m = np.full(m_pairs, -1, np.int64)
+    src_of_m[f_slot] = e1_slot
+    # class pads: identity flows (zero values, zero destinations)
+    padmask = np.ones(m_pairs, bool)
+    padmask[f_slot] = False
+    pads = np.nonzero(padmask)[0]
+    src_of_m[pads] = pads
+    realmask = (~padmask).astype(np.float32)
+    plan_m = plan_mod.build_route_plan(src_of_m, m_in=m_pairs, unit=2,
+                                       progress=progress)
+
+    # ---- plan_out: class order -> natural -------------------------------
+    # degree-0 nodes receive nothing: -1 slots read as exact zeros (the
+    # final pass accumulates from zero under an all-false mask)
+    src_out = np.full(n, -1, np.int64)
+    has = degree > 0
+    src_out[has] = rank[has]
+    plan_out = plan_mod.build_route_plan(src_out, m_in=nu, unit=2,
+                                         progress=progress)
+
+    return RoutedDelivery(
+        n=n, nu=nu, m_pairs=m_pairs, classes=classes,
+        plan_in=device_plan(plan_in),
+        plan_m=device_plan(plan_m),
+        plan_out=device_plan(plan_out),
+        realmask=jnp.asarray(realmask),
+        degree=jnp.asarray(degree, jnp.int32),
+    )

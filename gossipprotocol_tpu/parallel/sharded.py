@@ -97,6 +97,14 @@ def _sharded_core(
             all_sum=all_sum,
         )
     if cfg.fanout == "all":
+        if cfg.delivery == "routed":
+            raise ValueError(
+                "delivery='routed' is single-chip only: the routing plans "
+                "address one chip's HBM (sharding them would need per-shard "
+                "plan compilation plus a cross-shard exchange the scatter "
+                "path's psum_scatter already does minimally). Use "
+                "delivery='scatter' on meshes."
+            )
         return partial(
             pushsum_diffusion_round_core,
             n=n,

@@ -221,6 +221,62 @@ def pushsum_diffusion_round_core(
     jax.jit,
     static_argnames=(
         "n", "eps", "streak_target", "predicate", "tol", "all_alive",
+        "targets_alive", "interpret",
+    ),
+    inline=True,
+)
+def pushsum_diffusion_round_routed(
+    state: PushSumState,
+    routed,  # ops.delivery.RoutedDelivery (registered pytree)
+    base_key: jax.Array,
+    *,
+    n: int,
+    eps: float = 1e-10,
+    streak_target: int = 3,
+    predicate: str = "delta",
+    tol: float = 1e-4,
+    all_alive: bool = False,
+    targets_alive: bool = False,
+    interpret: bool = False,
+) -> PushSumState:
+    """Fanout-all round with the routed (scatter-free) delivery.
+
+    Same mathematics as :func:`pushsum_diffusion_round` — every node
+    keeps ``1/(deg+1)`` of ``(s, w)`` and ships one share per edge — but
+    delivery runs through the static routing plans of
+    :mod:`gossipprotocol_tpu.ops.delivery` instead of two random-index
+    ``segment_sum`` scatters.  Legality matches the gather-inverted
+    deliveries: exact under ``all_alive`` / ``targets_alive`` (the dead
+    set component-closed, so dead nodes exchange only zero shares).
+    Trajectories equal the scatter path to float accumulation order.
+    """
+    del base_key, targets_alive  # deterministic; closure on legality above
+    dt = state.s.dtype
+    rows = state.s.shape[0]
+    deg = routed.degree.astype(dt)
+    if rows > n:
+        deg = jnp.pad(deg, (0, rows - n))
+    inv = 1 / (deg + 1)
+    share_s = state.s * inv
+    share_w = state.w * inv
+    if not all_alive:
+        share_s = jnp.where(state.alive, share_s, 0)
+        share_w = jnp.where(state.alive, share_w, 0)
+    in_s, in_w = routed.matvec(share_s, share_w, interpret=interpret)
+    sent_s = share_s * deg
+    sent_w = share_w * deg
+    return finish_pushsum_round(
+        state, state.s - sent_s + in_s, state.w - sent_w + in_w,
+        received=in_w > 0, eps=eps, streak_target=streak_target,
+        reference_semantics=False, predicate=predicate, tol=tol,
+        all_sum=jnp.sum, all_alive=all_alive,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n", "eps", "streak_target", "predicate", "tol", "all_alive",
         "targets_alive",
     ),
     inline=True,

@@ -1,0 +1,169 @@
+"""Routed-delivery engine tests (ops/clos.py, ops/plan.py, ops/exec.py,
+ops/delivery.py).
+
+The routing pipeline is pure data movement, so the contracts are exact:
+the Clos tile router and the plan pipeline must reproduce `x[perm]`
+bitwise; the delivery matvec must match the adjacency matvec to float
+accumulation order (tree-of-pairs per class vs scatter order), the same
+contract as ``delivery='invert'``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu import build_topology
+from gossipprotocol_tpu.engine.driver import (
+    RunConfig, build_protocol, device_arrays,
+)
+from gossipprotocol_tpu.ops import clos
+from gossipprotocol_tpu.ops.delivery import build_routed_delivery
+from gossipprotocol_tpu.ops.exec import apply_plan, device_plan
+from gossipprotocol_tpu.ops.plan import apply_plan_np, build_route_plan
+
+
+@pytest.mark.parametrize("unit", [1, 2])
+def test_clos_tile_perm_exact(unit):
+    rng = np.random.default_rng(1)
+    u = clos.TILE // unit
+    perms = np.stack([rng.permutation(u) for _ in range(3)])
+    i1, i2, i3 = clos.route_tile_perms(perms, unit=unit)
+    for t in range(3):
+        x = rng.standard_normal((128, 128)).astype(np.float32)
+        y = clos.apply_route_np(x, i1[t], i2[t], i3[t])
+        ref = np.empty(clos.TILE, np.float32)
+        k = np.arange(u)
+        for j in range(unit):
+            ref[k * unit + j] = x.reshape(-1)[perms[t] * unit + j]
+        assert np.array_equal(y.reshape(-1), ref)
+
+
+def test_numpy_coloring_matches_native_properness():
+    # both backends must produce PROPER colorings (not identical ones)
+    rng = np.random.default_rng(2)
+    perm = rng.permutation(clos.TILE)
+    src_row = (perm // 128).astype(np.int32).reshape(1, -1)
+    k = np.arange(clos.TILE)
+    dst_row = (k // 128).astype(np.int32).reshape(1, -1)
+    for colors in (clos.euler_color_numpy(src_row, dst_row, 128),
+                   clos.color_tiles(src_row, dst_row, 128)):
+        c = colors.reshape(-1)
+        # proper: unique per src row and per dst row
+        assert len(set(zip(src_row[0], c))) == clos.TILE
+        assert len(set(zip(dst_row[0], c))) == clos.TILE
+
+
+@pytest.mark.parametrize("nt", [1, 3, 5])
+def test_plan_pipeline_exact(nt):
+    rng = np.random.default_rng(3)
+    m = nt * 8192
+    perm = rng.permutation(m).astype(np.int64)
+    plan = build_route_plan(perm, m_in=m, unit=2)
+    x = rng.standard_normal(nt * 16384).astype(np.float32)
+    y_np = apply_plan_np(plan, x)
+    dp = device_plan(plan)
+    y_dev = np.asarray(apply_plan(dp, jnp.asarray(x), interpret=True))
+    k = np.arange(m)
+    for j in (0, 1):
+        assert np.array_equal(y_np[k * 2 + j], x[perm * 2 + j])
+        assert np.array_equal(y_dev[k * 2 + j], x[perm * 2 + j])
+
+
+def test_plan_partial_with_dont_care_slots():
+    rng = np.random.default_rng(4)
+    m = 2 * 8192
+    perm = np.full(m, -1, np.int64)
+    real = rng.choice(m, size=m // 3, replace=False)
+    perm[real] = rng.choice(m, size=m // 3, replace=False)
+    plan = build_route_plan(perm, m_in=m, unit=2)
+    x = rng.standard_normal(2 * 16384).astype(np.float32)
+    y = np.asarray(apply_plan(device_plan(plan), jnp.asarray(x),
+                              interpret=True))
+    for j in (0, 1):
+        assert np.array_equal(y[real * 2 + j], x[perm[real] * 2 + j])
+
+
+@pytest.mark.parametrize("name,kw", [
+    ("er", dict(avg_degree=6.0)),
+    ("powerlaw", dict(m=3)),
+    ("3D", {}),
+    ("line", {}),
+])
+def test_delivery_matvec_matches_adjacency(name, kw):
+    topo = build_topology(name, 900, seed=7, **kw)
+    rd = build_routed_delivery(topo)
+    n = topo.num_nodes
+    rng = np.random.default_rng(5)
+    xs = rng.standard_normal(n).astype(np.float32)
+    xw = rng.standard_normal(n).astype(np.float32)
+    in_s, in_w = rd.matvec(jnp.asarray(xs), jnp.asarray(xw), interpret=True)
+    off, idx = np.asarray(topo.offsets), np.asarray(topo.indices)
+    src = np.repeat(np.arange(n), np.diff(off))
+    # float64 oracle: both f32 paths (scatter, routed) must sit within
+    # f32 accumulation distance of it
+    ref_s = np.zeros(n)
+    np.add.at(ref_s, idx, xs[src].astype(np.float64))
+    ref_w = np.zeros(n)
+    np.add.at(ref_w, idx, xw[src].astype(np.float64))
+    deg = np.maximum(np.diff(off), 1)
+    tol = 1e-5 * deg * np.maximum(1.0, np.abs(ref_s).max() / deg.max())
+    assert (np.abs(np.asarray(in_s) - ref_s) <= np.maximum(tol, 1e-4)).all()
+    assert (np.abs(np.asarray(in_w) - ref_w) <= np.maximum(tol, 1e-4)).all()
+
+
+def test_delivery_handles_isolated_nodes_and_padding_rows():
+    topo = build_topology("er", 500, seed=9, avg_degree=2.0)
+    deg = np.diff(np.asarray(topo.offsets))
+    assert (deg == 0).any(), "want isolated nodes in this config"
+    rd = build_routed_delivery(topo)
+    n = topo.num_nodes
+    rng = np.random.default_rng(6)
+    xs = jnp.asarray(rng.standard_normal(n + 37), jnp.float32)  # pad rows
+    xw = jnp.asarray(rng.standard_normal(n + 37), jnp.float32)
+    in_s, in_w = rd.matvec(xs, xw, interpret=True)
+    assert in_s.shape[0] == n + 37
+    assert np.all(np.asarray(in_s)[n:] == 0)
+    assert np.all(np.asarray(in_s)[:n][deg == 0] == 0)
+
+
+def test_routed_diffusion_round_matches_scatter():
+    topo = build_topology("powerlaw", 1500, seed=3, m=3)
+    base = dict(algorithm="push-sum", fanout="all", predicate="global",
+                tol=1e-4, seed=11)
+    res = {}
+    for delivery in ("scatter", "routed"):
+        cfg = RunConfig(**base, delivery=delivery)
+        state, core, _done, _extra, _flags = build_protocol(topo, cfg)
+        nbrs = device_arrays(topo, cfg)
+        key = jax.random.PRNGKey(0)
+        kw = {"interpret": True} if delivery == "routed" else {}
+        for _ in range(6):
+            state = core(state, nbrs, key, **kw)
+        res[delivery] = state
+    s_a, s_b = np.asarray(res["scatter"].s), np.asarray(res["routed"].s)
+    w_a, w_b = np.asarray(res["scatter"].w), np.asarray(res["routed"].w)
+    scale = np.abs(s_a).max()
+    assert np.abs(s_a - s_b).max() <= 1e-4 * scale
+    assert np.abs(w_a - w_b).max() <= 1e-4 * max(1.0, np.abs(w_a).max())
+    # mass conserved identically well
+    np.testing.assert_allclose(s_b.sum(), s_a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(w_b.sum(), w_a.sum(), rtol=1e-5)
+    assert (np.asarray(res["routed"].converged)
+            == np.asarray(res["scatter"].converged)).mean() > 0.99
+
+
+def test_routed_config_validation():
+    with pytest.raises(ValueError, match="fanout-all"):
+        RunConfig(algorithm="push-sum", fanout="one", delivery="routed")
+    with pytest.raises(ValueError, match="fanout-all"):
+        RunConfig(algorithm="gossip", delivery="routed")
+    with pytest.raises(ValueError, match="component-closed"):
+        RunConfig(algorithm="push-sum", fanout="all", delivery="routed",
+                  fault_plan={5: [1, 2]})
+    with pytest.raises(ValueError, match="f32|float64"):
+        RunConfig(algorithm="push-sum", fanout="all", delivery="routed",
+                  dtype="float64")
