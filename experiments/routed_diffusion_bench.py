@@ -1,0 +1,107 @@
+"""Routed vs scatter diffusion round, on the real chip (VERDICT r3 #1).
+
+Builds the BENCH power-law topology, compiles both round paths, and
+times ms/round amortized in one fori_loop dispatch each (memory:
+tpu-rig-run-discipline; dispatches sized under the remote watchdog).
+Prints one JSON line with the measured rounds for the artifact.
+
+Usage:
+  python experiments/routed_diffusion_bench.py [--nodes 1000000] [--m 4]
+      [--rounds 16] [--out artifacts/routed_diffusion.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu import build_topology
+from gossipprotocol_tpu.engine.driver import (
+    RunConfig, build_protocol, device_arrays,
+)
+
+
+def sync(x):
+    return float(jax.device_get(jnp.sum(x.ravel()[:8].astype(jnp.float32))))
+
+
+def timed(fn, repeats=3):
+    fn()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1_000_000)
+    ap.add_argument("--m", type=int, default=4)
+    ap.add_argument("--topology", default="powerlaw")
+    ap.add_argument("--rounds", type=int, default=16)
+    ap.add_argument("--deliveries", default="scatter,routed")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    print(f"device: {jax.devices()[0]}", flush=True)
+
+    t0 = time.perf_counter()
+    topo = build_topology(args.topology, args.nodes, seed=7, m=args.m,
+                          avg_degree=8.0)
+    print(f"topology: n={topo.num_nodes} edges={topo.num_directed_edges} "
+          f"({time.perf_counter()-t0:.1f}s)", flush=True)
+
+    results = {}
+    for delivery in args.deliveries.split(","):
+        cfg = RunConfig(algorithm="push-sum", fanout="all",
+                        predicate="global", tol=1e-4, seed=11,
+                        delivery=delivery)
+        t0 = time.perf_counter()
+        nbrs = device_arrays(topo, cfg)
+        t_build = time.perf_counter() - t0
+        state, core, _done, _extra, _fl = build_protocol(topo, cfg)
+        key = jax.random.PRNGKey(0)
+        R = args.rounds
+
+        # nbrs must be a jit ARGUMENT: closing over the routed plan's
+        # tables would embed GBs of int8 constants into the jaxpr and
+        # stall tracing/compile for tens of minutes (measured)
+        @jax.jit
+        def loop(s, nb):
+            def body(i, s):
+                return core(s, nb, key)
+            return jax.lax.fori_loop(0, R, body, s)
+
+        t = timed(lambda: sync(loop(state, nbrs).s)) / R
+        results[delivery] = dict(ms_per_round=t * 1e3,
+                                 build_s=t_build)
+        print(f"{delivery:8s}: {t*1e3:9.2f} ms/round "
+              f"(delivery build {t_build:.1f}s)", flush=True)
+
+    if "scatter" in results and "routed" in results:
+        sp = results["scatter"]["ms_per_round"] / results[
+            "routed"]["ms_per_round"]
+        print(f"speedup: {sp:.2f}x", flush=True)
+        results["speedup"] = sp
+    rec = dict(nodes=args.nodes, topology=args.topology, m=args.m,
+               rounds_timed=args.rounds, results=results,
+               device=str(jax.devices()[0]))
+    print(json.dumps(rec), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -72,12 +72,16 @@ def resume_argv(argv, checkpoint_dir, attempts_left):
         if a in ("--resume", "--auto-resume"):
             skip = True
             continue
-        if a.startswith(("--resume=", "--auto-resume=")):
+        if a.startswith(("--resume=", "--auto-resume=")) or a == "--restarted":
             continue
         out.append(a)
     if checkpoint_dir is not None:
         out += ["--resume", checkpoint_dir]
-    return out + ["--auto-resume", str(attempts_left)]
+    # --restarted keeps --metrics-out in append mode even when no
+    # checkpoint landed before the crash (scratch restart): without it
+    # the re-exec would reopen the file with mode='w' and silently
+    # discard every pre-crash record of the same logical run
+    return out + ["--auto-resume", str(attempts_left), "--restarted"]
 
 
 def _is_runtime_death(e: BaseException) -> bool:
@@ -184,7 +188,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "per side)")
     p.add_argument("--ws-beta", type=float, default=0.1,
                    help="small_world rewiring probability in [0, 1] "
-                        "(0 = ring lattice, 1 = random graph)")
+                        "(0 = ring lattice, 1 = random graph). Rewired "
+                        "chords that collide (self-loop/duplicate) are "
+                        "DROPPED, not redrawn — edge count can dip below "
+                        "n*k/2 at high beta, unlike networkx's "
+                        "redraw-until-clean Watts-Strogatz")
     p.add_argument("--metrics-out", type=str, default=None,
                    help="JSONL file for per-chunk metrics records")
     p.add_argument("--checkpoint-dir", type=str, default=None)
@@ -201,7 +209,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "retry keeps failing UNAVAILABLE), so recovery is "
                         "a fresh process. With --checkpoint-dir/--checkpoint-"
                         "every the run resumes from the latest checkpoint; "
-                        "without, it restarts from scratch")
+                        "without, it restarts from scratch. Single-process "
+                        "only (rejected with --devices > 1: uncoordinated "
+                        "per-process re-execs would race the distributed "
+                        "mesh init)")
+    p.add_argument("--restarted", action="store_true",
+                   help=argparse.SUPPRESS)  # set by recovery re-execs only
     p.add_argument("--fail-fraction", type=float, default=0.0,
                    help="fault injection: kill this fraction of nodes")
     p.add_argument("--fail-round", type=int, default=0,
@@ -338,6 +351,20 @@ def main(argv=None) -> int:
                 "address one chip's HBM) — drop --devices or use "
                 "delivery='scatter'"
             )
+        if cfg.delivery == "routed" and topo.implicit_full:
+            raise ValueError(
+                "delivery='routed' needs an explicit edge list; the "
+                "complete graph has none (diffusion on K_n mixes in one "
+                "round via two reductions) — use delivery='scatter'"
+            )
+        if args.auto_resume > 0 and args.devices > 1:
+            raise ValueError(
+                "--auto-resume is single-process only: each process would "
+                "independently re-exec after a fixed grace sleep with no "
+                "barrier before re-initializing the distributed runtime, "
+                "leaving a hung or mismatched mesh — recover multi-process "
+                "runs by relaunching the job from --checkpoint-dir"
+            )
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -403,7 +430,9 @@ def main(argv=None) -> int:
     # re-run on resume and their records re-emitted — so a resume writes a
     # marker record first; consumers dedup on (round) after the marker.
     writer = (
-        JsonlMetricsWriter(args.metrics_out, mode="a" if args.resume else "w")
+        JsonlMetricsWriter(
+            args.metrics_out,
+            mode="a" if (args.resume or args.restarted) else "w")
         if args.metrics_out else None
     )
     if writer:
@@ -414,6 +443,14 @@ def main(argv=None) -> int:
                 "from_round": int(meta.get("round", -1)),
                 "note": "records after this marker may replay rounds "
                         "already present above (at-least-once)",
+            })
+        elif args.restarted:
+            # recovery re-exec with no checkpoint: same file, whole run
+            # replays — mark it instead of truncating the pre-crash records
+            writer({
+                "event": "restarted_from_scratch",
+                "note": "recovery without a checkpoint: every round "
+                        "replays; records above are the crashed attempt",
             })
 
     if not args.quiet:

@@ -348,6 +348,19 @@ def build_protocol(
                 all_alive=all_alive,
                 targets_alive=targets_alive,
             )
+            if cfg.delivery == "routed":
+                # Mosaic kernels only exist for TPU; every other backend
+                # (the CPU test mesh included) runs the same kernels
+                # through the Pallas interpreter. jax_default_device may
+                # hold a Device or a bare platform string.
+                dev = jax.config.jax_default_device
+                if dev is None:
+                    plat = jax.default_backend()
+                elif isinstance(dev, str):
+                    plat = dev
+                else:
+                    plat = dev.platform
+                core = partial(core, interpret=(plat != "tpu"))
         else:
             if cfg.delivery == "invert":
                 # loud config errors, not silent fallbacks (SURVEY.md §5.6)

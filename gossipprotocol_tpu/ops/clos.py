@@ -139,6 +139,18 @@ def route_tile_perms(perms: np.ndarray, unit: int = 2):
     upr = LANES // unit            # units per row
     assert U == ROWS * upr, (U, upr)
 
+    if T > 512:
+        # tile batches bound the [T, U] int64 temporaries below (~tens of
+        # MB per batch instead of GBs at 10M-scale plans); slices land in
+        # preallocated outputs so the idx triples are never held twice
+        i1 = np.empty((T, ROWS, LANES), np.int8)
+        i2 = np.empty((T, ROWS, LANES), np.int8)
+        i3 = np.empty((T, ROWS, LANES), np.int8)
+        for lo in range(0, T, 512):
+            a, b, c = route_tile_perms(perms[lo: lo + 512], unit=unit)
+            i1[lo: lo + 512], i2[lo: lo + 512], i3[lo: lo + 512] = a, b, c
+        return i1, i2, i3
+
     src_row = (perms // upr).astype(np.int32)
     src_col = (perms % upr).astype(np.int32)
     k = np.arange(U, dtype=np.int64)

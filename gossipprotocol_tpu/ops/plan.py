@@ -100,26 +100,26 @@ def _complete_bijections(perm: np.ndarray, u: int) -> np.ndarray:
     r, width = perm.shape
     assert width == u
     out = perm.copy()
-    used = np.zeros((r, u), bool)
-    rows = np.repeat(np.arange(r), u)
-    real = out >= 0
-    used[rows.reshape(r, u)[real], out[real]] = True
-    # rank unused sources and unfilled slots per row, match by rank
-    free_src = ~used
-    slot_rank = np.cumsum(~real, axis=1) - 1       # rank among -1 slots
-    src_rank = np.cumsum(free_src, axis=1) - 1     # rank among free sources
-    # build per-row list of free sources ordered by source id
-    free_counts = free_src.sum(1)
-    assert (free_counts == (~real).sum(1)).all(), "perm rows not injective"
-    # gather: for each row, free_sources[rank] — vectorized via argsort
-    # position of the j-th free source: use cumcount inversion
-    src_ids = np.broadcast_to(np.arange(u), (r, u))
-    # table[row, rank] = source id
-    table = np.full((r, u), -1, np.int64)
-    table[np.broadcast_to(np.arange(r)[:, None], (r, u))[free_src],
-          src_rank[free_src]] = src_ids[free_src]
-    out[~real] = table[np.broadcast_to(np.arange(r)[:, None], (r, u))[~real],
-                       slot_rank[~real]]
+    ar_u = np.arange(u)
+    # row chunks keep the [chunk, u] work arrays at tens of MB; the
+    # unchunked version materialized several [R, u] int64 temporaries and
+    # hit ~8 GB during a 1M-pair plan build (measured)
+    chunk = 512
+    for lo in range(0, r, chunk):
+        p = out[lo: lo + chunk]
+        c = p.shape[0]
+        real = p >= 0
+        rows_c = np.broadcast_to(np.arange(c)[:, None], (c, u))
+        used = np.zeros((c, u), bool)
+        used[rows_c[real], p[real]] = True
+        assert (used.sum(1) == real.sum(1)).all(), "perm rows not injective"
+        free_src = ~used
+        slot_rank = np.cumsum(~real, axis=1) - 1   # rank among -1 slots
+        src_rank = np.cumsum(free_src, axis=1) - 1  # rank among free sources
+        table = np.full((c, u), -1, np.int64)      # table[row, rank] = src
+        table[rows_c[free_src], src_rank[free_src]] = (
+            np.broadcast_to(ar_u, (c, u))[free_src])
+        p[~real] = table[rows_c[~real], slot_rank[~real]]
     return out
 
 

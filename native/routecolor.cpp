@@ -10,7 +10,7 @@
 // each leaf is a perfect matching, which gets one color.  O(E log n)
 // per tile; n must be a power of two.
 //
-// The numpy fallback in gossipprotocol_tpu/ops/routing.py implements the
+// The numpy fallback in gossipprotocol_tpu/ops/clos.py implements the
 // same algorithm; tests assert both produce proper colorings (colors are
 // not required to match bit-for-bit — any proper coloring routes).
 //

@@ -24,7 +24,11 @@ os.environ.setdefault(
 )
 # CLI tests must reuse the suite's compile cache below, not mutate the
 # developer's ~/.cache (the CLI's --compile-cache default honors this)
-os.environ.setdefault("GOSSIP_TPU_COMPILE_CACHE", "/tmp/jax_compile_cache")
+os.environ.setdefault(
+    "GOSSIP_TPU_COMPILE_CACHE", f"/tmp/jax_compile_cache-{os.getuid()}"
+)  # uid-scoped: concurrent users on one host must not collide on
+   # file ownership in a shared world-writable cache dir
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -37,8 +41,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 # persistent XLA compile cache: this box has one CPU core and pays seconds
-# per fresh compile; cached reruns of the suite are near-instant
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+# per fresh compile; cached reruns of the suite are near-instant. Same
+# uid-scoped path as GOSSIP_TPU_COMPILE_CACHE above so CLI tests (which
+# honor that env var) and direct-jax tests share one cache
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["GOSSIP_TPU_COMPILE_CACHE"])
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 

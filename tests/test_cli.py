@@ -279,10 +279,15 @@ def test_resume_argv_rewrite():
             "--resume=/old/ck", "--seed", "7"]
     out = resume_argv(argv, "/ck", 2)
     assert out == ["64", "imp3D", "push-sum", "--seed", "7",
-                   "--resume", "/ck", "--auto-resume", "2"]
-    # no checkpoint landed: restart from scratch, budget still decremented
-    out = resume_argv(argv, None, 0)
-    assert "--resume" not in out and out[-2:] == ["--auto-resume", "0"]
+                   "--resume", "/ck", "--auto-resume", "2", "--restarted"]
+    # no checkpoint landed: restart from scratch, budget still decremented;
+    # --restarted keeps --metrics-out appending instead of truncating the
+    # crashed attempt's records (ADVICE r3), and must not accumulate
+    # across chained recoveries
+    out = resume_argv(argv + ["--restarted"], None, 0)
+    assert "--resume" not in out
+    assert out[-3:] == ["--auto-resume", "0", "--restarted"]
+    assert out.count("--restarted") == 1
 
 
 def test_auto_resume_reexecs_from_latest_checkpoint(
@@ -334,7 +339,8 @@ def test_auto_resume_reexecs_from_latest_checkpoint(
     code = cli.main(argv)
     assert code == 42
     got = captured["argv"]
-    assert got[-4:] == ["--resume", ckdir, "--auto-resume", "1"]
+    assert got[-5:] == ["--resume", ckdir, "--auto-resume", "1",
+                        "--restarted"]
     # without remaining budget the error propagates
     import pytest as _pytest
     with _pytest.raises(Exception, match="UNAVAILABLE"):
@@ -429,3 +435,39 @@ def test_auto_resume_skips_incompatible_stale_dir(
                        "--resume", good_dir, "--auto-resume", "1"])
     got = captured["argv"]
     assert got[got.index("--resume") + 1] == good_dir, got
+
+
+def test_routed_delivery_cli_preflight(capsys):
+    """--delivery routed input errors surface as exit-2 messages, not
+    tracebacks (SURVEY.md §5.6 loud-error rule)."""
+    code, _, err = run_cli([
+        "64", "full", "push-sum", "--fanout", "all", "--delivery", "routed",
+    ], capsys)
+    assert code == 2 and "explicit edge list" in err
+    code, _, err = run_cli([
+        "64", "imp3D", "push-sum", "--fanout", "all", "--delivery", "routed",
+        "--devices", "8",
+    ], capsys)
+    assert code == 2 and "single-chip" in err
+    code, _, err = run_cli([
+        "64", "imp3D", "push-sum", "--delivery", "routed",
+    ], capsys)
+    assert code == 2 and "fanout-all" in err
+
+
+def test_auto_resume_rejected_with_devices(capsys):
+    code, _, err = run_cli([
+        "64", "imp3D", "gossip", "--devices", "8", "--backend", "cpu",
+        "--auto-resume", "2",
+    ], capsys)
+    assert code == 2 and "single-process" in err
+
+
+def test_routed_delivery_cli_runs(capsys):
+    import re as _re
+    code, out, _ = run_cli([
+        "300", "erdos_renyi", "push-sum", "--fanout", "all",
+        "--delivery", "routed", "--predicate", "global", "--seed", "2",
+    ], capsys)
+    assert code == 0
+    assert _re.search(r"Convergence Time: \d+\.\d+ ms", out)
