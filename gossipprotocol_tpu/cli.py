@@ -470,6 +470,16 @@ def main(argv=None) -> int:
             else:
                 result = run_simulation(topo, cfg)
     except Exception as e:
+        # routed-delivery build rejections are user input errors that can
+        # only surface once the plan compiler sees the graph — same
+        # loud-exit-2 contract as the preflight checks above
+        from gossipprotocol_tpu.ops.delivery import RoutedConfigError
+
+        if isinstance(e, RoutedConfigError):
+            if writer:
+                writer.close()
+            print(str(e), file=sys.stderr)
+            return 2
         if not (_is_runtime_death(e) and args.auto_resume > 0):
             raise
         # elastic recovery (SURVEY.md §5.3): the client is unrecoverable

@@ -330,7 +330,11 @@ def build_protocol(
             )
 
             if cfg.delivery == "routed" and not targets_alive:
-                raise ValueError(
+                from gossipprotocol_tpu.ops.delivery import (
+                    RoutedConfigError,
+                )
+
+                raise RoutedConfigError(
                     "delivery='routed' is exact only while the dead set "
                     "is component-closed (no fault plan, no resumed "
                     "arbitrary dead set) — use delivery='scatter'"

@@ -53,6 +53,10 @@ from gossipprotocol_tpu.topology.base import Topology
 TILE = 128 * 128
 
 
+class RoutedConfigError(ValueError):
+    """Routed-delivery build rejected the configuration (user-facing)."""
+
+
 def _ceil_pow2(x: np.ndarray) -> np.ndarray:
     x = np.maximum(x, 1)
     return (1 << np.ceil(np.log2(x)).astype(np.int64)).astype(np.int64)
@@ -102,6 +106,14 @@ def _register_delivery():
     jax.tree_util.register_pytree_node(RoutedDelivery, flatten, unflatten)
 
 
+def _apply_chain(plans, x, take_f32, interpret):
+    """Run ``x`` through consecutive plans, then slice to ``take_f32``."""
+    for p in plans:
+        pad = p.m_in_f32 - x.shape[0]
+        x = apply_plan(p, jnp.pad(x, (0, pad)) if pad else x, interpret)
+    return x[:take_f32]
+
+
 class RoutedDelivery(NamedTuple):  # registered below: geometry static
     """Device-side routed delivery for one topology (a pytree)."""
 
@@ -109,9 +121,9 @@ class RoutedDelivery(NamedTuple):  # registered below: geometry static
     nu: int                      # nodes with degree > 0
     m_pairs: int                 # class-layout pair slots
     classes: Tuple[Tuple[int, int, int], ...]  # (c, n_c, start_pair)
-    plan_in: DevicePlan
-    plan_m: DevicePlan
-    plan_out: DevicePlan
+    plan_in: Tuple[DevicePlan, ...]   # natural -> class order (chained)
+    plan_m: Tuple[DevicePlan, ...]    # the edge permutation
+    plan_out: Tuple[DevicePlan, ...]  # class -> natural order (chained)
     realmask: jax.Array          # f32 [m_pairs] 1.0 on real slots
     degree: jax.Array            # int32 [n]
 
@@ -123,9 +135,8 @@ class RoutedDelivery(NamedTuple):  # registered below: geometry static
         """
         rows = xs.shape[0]
         pairs = jnp.stack([xs[: self.n], xw[: self.n]], -1).reshape(-1)
-        pad = self.plan_in.m_in_f32 - pairs.shape[0]
-        cls = apply_plan(self.plan_in, jnp.pad(pairs, (0, pad)),
-                         interpret)[: self.nu * 2].reshape(self.nu, 2)
+        cls = _apply_chain(self.plan_in, pairs, self.nu * 2,
+                           interpret).reshape(self.nu, 2)
         segs = []
         off = 0
         for c, n_c, start in self.classes:
@@ -134,19 +145,15 @@ class RoutedDelivery(NamedTuple):  # registered below: geometry static
                 seg[:, None, :], (n_c, c, 2)).reshape(-1, 2))
             off += n_c
         e1 = jnp.concatenate(segs, 0) * self.realmask[:, None]
-        e1f = e1.reshape(-1)
-        pad = self.plan_m.m_in_f32 - e1f.shape[0]
-        routed = apply_plan(self.plan_m, jnp.pad(e1f, (0, pad)),
-                            interpret)[: self.m_pairs * 2]
-        f = routed.reshape(self.m_pairs, 2)
+        f = _apply_chain(self.plan_m, e1.reshape(-1), self.m_pairs * 2,
+                         interpret).reshape(self.m_pairs, 2)
         ys = []
         for c, n_c, start in self.classes:
             seg = jax.lax.dynamic_slice_in_dim(f, start, n_c * c, 0)
             ys.append(seg.reshape(n_c, c, 2).sum(1))
         yf = jnp.concatenate(ys, 0).reshape(-1)
-        pad = self.plan_out.m_in_f32 - yf.shape[0]
-        nat = apply_plan(self.plan_out, jnp.pad(yf, (0, pad)),
-                         interpret)[: self.n * 2].reshape(self.n, 2)
+        nat = _apply_chain(self.plan_out, yf, self.n * 2,
+                           interpret).reshape(self.n, 2)
         if rows > self.n:
             nat = jnp.pad(nat, ((0, rows - self.n), (0, 0)))
         return nat[:, 0], nat[:, 1]
@@ -163,8 +170,9 @@ def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
     delivery the north-star configs need at 10M nodes.
     """
     if topo.implicit_full:
-        raise ValueError("routed delivery: complete graph needs no edges "
-                         "(diffusion mixes in one round via reductions)")
+        raise RoutedConfigError(
+            "routed delivery: complete graph needs no edges "
+            "(diffusion mixes in one round via reductions)")
     n = topo.num_nodes
     offsets = np.asarray(topo.offsets, np.int64)
     indices = np.asarray(topo.indices, np.int64)
@@ -172,11 +180,24 @@ def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
     cls = _ceil_pow2(degree)
     cls[degree == 0] = 0
 
-    # class-major node order (stable -> deterministic)
+    # class-major node order; WITHIN each class the order is shuffled
+    # (seeded, deterministic). This is load-bearing, not cosmetic: the
+    # radix plans use uniform per-(tile, bucket) run capacities sized by
+    # the MAX cell count, which assumes flows spread randomly. A sorted
+    # within-class order makes the delivery permutations near
+    # block-diagonal (a line graph is the worst case: perfectly
+    # diagonal), concentrating whole tiles into single buckets — CR blew
+    # up to 64 rows and the final merge to K=39 stacked tiles before
+    # this shuffle (measured at 60K BA m=4).
     order = np.argsort(np.where(cls == 0, np.iinfo(np.int64).max, cls),
                        kind="stable")
     nu = int((degree > 0).sum())
     order = order[:nu]                       # degree-0 nodes excluded
+    rng = np.random.default_rng(0xC105)
+    c_tmp = cls[order]
+    bounds = np.r_[0, np.flatnonzero(np.diff(c_tmp)) + 1, nu]
+    for i, j in zip(bounds[:-1], bounds[1:]):
+        order[i:j] = order[i + rng.permutation(j - i)]
     rank = np.full(n, -1, np.int64)
     rank[order] = np.arange(nu)
 
@@ -187,25 +208,25 @@ def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
     m_pairs = int(starts[-1])
 
     # class segment table (c, n_c, start_pair)
-    classes = []
-    i = 0
-    while i < nu:
-        c = int(c_sorted[i])
-        j = i
-        while j < nu and c_sorted[j] == c:
-            j += 1
-        classes.append((c, j - i, int(starts[i])))
-        i = j
-    classes = tuple(classes)
+    cb = np.r_[0, np.flatnonzero(np.diff(c_sorted)) + 1, nu]
+    classes = tuple(
+        (int(c_sorted[i]), int(j - i), int(starts[i]))
+        for i, j in zip(cb[:-1], cb[1:]))
 
     if progress:
         progress(f"routed delivery: n={n} nu={nu} m_pairs={m_pairs} "
                  f"classes={[(c, k) for c, k, _ in classes]}")
 
     # ---- plan_in: natural -> class order --------------------------------
+    # Chained through a stride scramble: node ids correlate with degree
+    # (BA growth order), so the class permutation clusters sources into
+    # narrow tile bands — built directly, its radix cells concentrate
+    # (measured K=62 final merge at 1M, a VMEM OOM). A multiplicative
+    # stride rho(i) = i*P mod m spreads every contiguous band perfectly
+    # uniformly, and the composition class_order o rho^-1 inherits the
+    # spread; two well-behaved plans replace one pathological one.
     src_in = order.copy()                    # out slot k <- node order[k]
-    plan_in = plan_mod.build_route_plan(src_in, m_in=n, unit=2,
-                                        progress=progress)
+    plans_in = _chained_plans(src_in, m_in=n, progress=progress)
 
     # ---- plan_m: edge permutation on the class layout -------------------
     # directed edge e (row u, slot k): E1 slot = starts[rank[u]] + k
@@ -225,29 +246,92 @@ def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
     f_slot = starts[rank[indices]] + in_rank
     src_of_m = np.full(m_pairs, -1, np.int64)
     src_of_m[f_slot] = e1_slot
-    # class pads: identity flows (zero values, zero destinations)
+    # class pads carry zeros; pair them by a seeded RANDOM permutation —
+    # identity pairing would add a block-diagonal component to the
+    # permutation and re-concentrate the radix cells the within-class
+    # shuffle above just spread (same capacity blowup)
     padmask = np.ones(m_pairs, bool)
     padmask[f_slot] = False
     pads = np.nonzero(padmask)[0]
-    src_of_m[pads] = pads
+    src_of_m[pads] = pads[rng.permutation(pads.size)]
     realmask = (~padmask).astype(np.float32)
-    plan_m = plan_mod.build_route_plan(src_of_m, m_in=m_pairs, unit=2,
-                                       progress=progress)
+    # Chained like the N-plans: even with the within-class shuffle, a
+    # hub's out-slot tiles target single class regions (its neighbors'
+    # classes aren't uniform), skewing bucket loads ~7x on power-law
+    # graphs (measured: max cell 463 pairs vs avg 64 at 1M BA, O=8).
+    # The stride chain makes cell loads uniform for ANY permutation at
+    # the price of one extra routed pass; per-bucket capacities would
+    # recover that pass and are the noted follow-up.
+    plans_m = _chained_plans(src_of_m, m_in=m_pairs, progress=progress)
 
-    # ---- plan_out: class order -> natural -------------------------------
+    # ---- plan_out: class order -> natural (chained, see plan_in) --------
     # degree-0 nodes receive nothing: -1 slots read as exact zeros (the
     # final pass accumulates from zero under an all-false mask)
     src_out = np.full(n, -1, np.int64)
     has = degree > 0
     src_out[has] = rank[has]
-    plan_out = plan_mod.build_route_plan(src_out, m_in=nu, unit=2,
-                                         progress=progress)
+    plans_out = _chained_plans(src_out, m_in=nu, progress=progress)
 
     return RoutedDelivery(
         n=n, nu=nu, m_pairs=m_pairs, classes=classes,
-        plan_in=device_plan(plan_in),
-        plan_m=device_plan(plan_m),
-        plan_out=device_plan(plan_out),
+        plan_in=tuple(device_plan(p) for p in plans_in),
+        plan_m=tuple(device_plan(p) for p in plans_m),
+        plan_out=tuple(device_plan(p) for p in plans_out),
         realmask=jnp.asarray(realmask),
         degree=jnp.asarray(degree, jnp.int32),
     )
+
+
+def _check_geometry(name: str, p) -> None:
+    """Loud failure if a plan's capacities concentrated (SURVEY §5.6).
+
+    The radix scheme sizes runs by the max per-(tile, bucket) cell; the
+    within-class shuffle and random pad pairing are supposed to keep the
+    edge permutation spread. If a topology still concentrates cells, the
+    kernels would compile huge merges (or OOM VMEM) — fail at build time
+    with the knob to turn instead.
+    """
+    worst_o = max((st.o for st in p.stages), default=1)
+    if worst_o > 4 or p.final.k > 6:
+        raise RoutedConfigError(
+            f"routed delivery: {name} routing concentrated (stacked "
+            f"tiles O={worst_o}, final merge K={p.final.k}) — this "
+            "topology defeats the class-shuffle spreading; use "
+            "delivery='scatter' and report the config"
+        )
+
+
+def _chained_plans(src_of: np.ndarray, m_in: int, progress=None):
+    """Two well-spread plans implementing one structured permutation.
+
+    rho(i) = i * P mod m (P coprime to m): every contiguous input band
+    spreads uniformly over output tiles, so BOTH rho and
+    (src_of o rho^-1) route with minimal capacities regardless of how
+    clustered ``src_of`` is.  Returns plans applied left-to-right.
+    """
+    m = int(m_in)
+    p_stride = _coprime_stride(m)
+    k = np.arange(m, dtype=np.int64)
+    rho = (k * p_stride) % m                 # out slot j <- in slot rho[j]
+    rho_inv = np.empty(m, np.int64)
+    rho_inv[rho] = k
+    plan1 = plan_mod.build_route_plan(rho, m_in=m, unit=2,
+                                      progress=progress)
+    src2 = np.where(src_of >= 0, rho_inv[np.clip(src_of, 0, m - 1)], -1)
+    plan2 = plan_mod.build_route_plan(src2, m_in=m, unit=2,
+                                      progress=progress)
+    _check_geometry("stride plan", plan1)
+    _check_geometry("descrambled plan", plan2)
+    return (plan1, plan2)
+
+
+def _coprime_stride(m: int) -> int:
+    """A large multiplier coprime to m (golden-ratio-ish spread)."""
+    import math
+
+    if m <= 2:
+        return 1
+    p = int(m * 0.6180339887) | 1
+    while math.gcd(p, m) != 1:
+        p += 2
+    return p

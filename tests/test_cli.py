@@ -471,3 +471,20 @@ def test_routed_delivery_cli_runs(capsys):
     ], capsys)
     assert code == 0
     assert _re.search(r"Convergence Time: \d+\.\d+ ms", out)
+
+
+def test_routed_build_rejection_is_exit2(capsys, monkeypatch):
+    """Build-time routed rejections (only diagnosable once the plan
+    compiler sees the graph) follow the same exit-2 contract as the
+    preflights — not a traceback (found by code review)."""
+    from gossipprotocol_tpu.ops import delivery as dlv
+
+    def bomb(topo, progress=None):
+        raise dlv.RoutedConfigError("plan_m routing concentrated (test)")
+
+    monkeypatch.setattr(dlv, "build_routed_delivery", bomb)
+    code, _, err = run_cli([
+        "300", "erdos_renyi", "push-sum", "--fanout", "all",
+        "--delivery", "routed",
+    ], capsys)
+    assert code == 2 and "concentrated" in err

