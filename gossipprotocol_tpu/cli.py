@@ -31,13 +31,14 @@ import argparse
 import os
 import sys
 
-def _build_config(args, algo, fault_plan, jnp):
+def _build_config(args, algo, fault_plan, jnp, alert_quorum=None):
     """argv -> RunConfig; raises ValueError on invalid combinations
     (caught by main and reported as exit 2, the bad-input contract)."""
     from gossipprotocol_tpu.engine import RunConfig
 
     return RunConfig(
         algorithm=algo,
+        alert_quorum=alert_quorum,
         dtype=jnp.float64 if args.x64 else jnp.float32,
         seed=args.seed,
         threshold=args.threshold,
@@ -57,6 +58,54 @@ def _build_config(args, algo, fault_plan, jnp):
         checkpoint_dir=args.checkpoint_dir,
         fault_plan=fault_plan,
     )
+
+
+def _build_run_topology(args):
+    """argv -> (Topology, alert_quorum) with reference-mode population.
+
+    ``--semantics reference`` renders the reference's N+1-actor quirk
+    (``Program.fs:169-176`` spawns actors ``0..nodes``; the supervisor
+    exits at ``nodes`` Alerts, ``Program.fs:53``):
+
+      * line/full: the wiring loops cover all ``nodes+1`` actors
+        (``Program.fs:184-189, 211-216``), so the graph is built one
+        node larger and the run converges at ``nodes`` settled — all
+        but one.
+      * 3D/imp3D: ``nodes`` is first mutated to the cube
+        (``Program.fs:239-240``); the wiring covers cube indices only,
+        so the extra actor exists but is isolated — rendered as one
+        edge-less row, which the birth-exclusion rule keeps out of the
+        predicate (the supervisor hears exactly cube Alerts).
+      * imp3D additionally draws its extra neighbor with the
+        reference's exact off-by-one, directed, self/duplicate-allowing
+        rule (:func:`build_imp3d_reference_quirks`).
+
+    Intended mode and the non-reference topologies are untouched.
+    """
+    from gossipprotocol_tpu.topology import build_topology
+    from gossipprotocol_tpu.topology.builders import (
+        add_isolated_rows, build_imp3d_reference_quirks,
+    )
+    from gossipprotocol_tpu.topology.registry import canonical_name
+
+    name = canonical_name(args.topology)
+    ref = args.semantics == "reference"
+    if ref and name in ("line", "full"):
+        topo = build_topology(name, args.num_nodes + 1)
+        return topo, args.num_nodes
+    if ref and name == "imp3D":
+        return add_isolated_rows(
+            build_imp3d_reference_quirks(args.num_nodes, seed=args.seed)
+        ), None
+    if ref and name == "3D":
+        return add_isolated_rows(
+            build_topology(name, args.num_nodes)), None
+    topo = build_topology(
+        args.topology, args.num_nodes,
+        seed=args.seed, avg_degree=args.avg_degree, m=args.attach,
+        k=args.ws_k, beta=args.ws_beta,
+    )
+    return topo, None
 
 
 def resume_argv(argv, checkpoint_dir, attempts_left):
@@ -297,17 +346,20 @@ def main(argv=None) -> int:
         return 2
 
     try:
-        topo = build_topology(
-            args.topology, args.num_nodes,
-            seed=args.seed, avg_degree=args.avg_degree, m=args.attach,
-            k=args.ws_k, beta=args.ws_beta,
-        )
+        topo, alert_quorum = _build_run_topology(args)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
     if not args.quiet and topo.num_nodes != args.num_nodes:
-        print(f"note: {args.topology} rounds {args.num_nodes} up to "
-              f"{topo.num_nodes} nodes (Program.fs:239-240 semantics)")
+        if args.semantics == "reference":
+            quorum_note = (f", supervisor exits at {alert_quorum} Alerts"
+                           if alert_quorum else "")
+            print(f"note: reference population is {topo.num_nodes} actors "
+                  f"for {args.num_nodes} requested nodes "
+                  f"(Program.fs:169-176,239-240{quorum_note})")
+        else:
+            print(f"note: {args.topology} rounds {args.num_nodes} up to "
+                  f"{topo.num_nodes} nodes (Program.fs:239-240 semantics)")
 
     if args.check:
         try:
@@ -333,7 +385,8 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     try:
-        cfg = _build_config(args, algo, fault_plan, jnp)
+        cfg = _build_config(args, algo, fault_plan, jnp,
+                            alert_quorum=alert_quorum)
         if cfg.delivery == "invert":
             # surface the engine's build-time preconditions as clean CLI
             # input errors (exit 2), not tracebacks mid-run

@@ -68,6 +68,10 @@ class RunConfig:
     streak_target: int = 3         # consecutive small-delta rounds (Program.fs:121)
     keep_alive: bool = True        # bulk-sync analogue of Actor2 (Program.fs:141-163)
     semantics: str = "intended"    # "intended" | "reference"
+    alert_quorum: Optional[int] = None  # settled-node count that ends the
+                                   # run (None = all). Renders the
+                                   # reference's N+1 population converging
+                                   # at N Alerts (Program.fs:169-176,53)
     predicate: str = "delta"       # push-sum: "delta" (reference-intended,
                                    # local) | "global" (sound; see pushsum.py)
     tol: float = 1e-4              # push-sum global-predicate tolerance
@@ -109,6 +113,8 @@ class RunConfig:
                 "predicate='global' is incompatible with semantics='reference' "
                 "(the reference's accidental rule ignores the estimate entirely)"
             )
+        if self.alert_quorum is not None and self.alert_quorum < 1:
+            raise ValueError("alert_quorum must be >= 1")
         if self.fanout not in ("one", "all"):
             raise ValueError("fanout must be 'one' or 'all'")
         if self.fanout == "all" and self.semantics == "reference":
@@ -309,12 +315,16 @@ def build_protocol(
         # intended rule is 10 (README.md:2)
         threshold = cfg.threshold + 1 if ref else cfg.threshold
         state = gossip_init(rows, seed_node)
+        # reference mode renders Actor2's asymmetry: the keep-alive
+        # driver is started for line/3D/imp3D gossip (Program.fs:200,
+        # 271) but NOT for the full topology (Program.fs:224-228 sends
+        # no Adder) — full-topology gossip there has no liveness net
+        keep_alive = cfg.keep_alive and not (ref and topo.kind == "full")
         core = partial(
-            gossip_round, n=n, threshold=threshold, keep_alive=cfg.keep_alive,
+            gossip_round, n=n, threshold=threshold, keep_alive=keep_alive,
             all_alive=all_alive, inverted=gossip_inversion_enabled(topo, cfg),
         )
         done_fn = gossip_done
-        keep_alive = cfg.keep_alive
         extra_stats = lambda s: {  # noqa: E731
             "spreading": gossip_spreading_count(s, keep_alive)
         }
@@ -400,6 +410,19 @@ def build_protocol(
             alive=state.alive & ~pad_dead,
             converged=state.converged | pad_dead,
         )
+
+    if cfg.alert_quorum is not None:
+        # the reference's supervisor exits at counter = nodes while the
+        # factory spawned nodes+1 actors (Program.fs:169-176,53): global
+        # convergence = all-but-(population - quorum) settled. Padding
+        # rows are pre-settled above, so they shift the threshold.
+        q = cfg.alert_quorum + (rows - n)
+
+        def done_fn(state, _q=q):  # noqa: F811 — quorum supervisor
+            settled = jnp.sum(
+                (state.converged | ~state.alive).astype(jnp.int32))
+            return settled >= _q
+
     return state, core, done_fn, extra_stats, (all_alive, targets_alive)
 
 
@@ -415,6 +438,12 @@ def require_invertible(topo: Topology) -> None:
         DENSE_MAX_DEGREE, use_dense,
     )
 
+    if topo.asymmetric:
+        raise ValueError(
+            "delivery='invert' needs a symmetric simple graph; this "
+            "reference-quirks topology carries directed/self/duplicate "
+            "entries — use delivery='scatter'"
+        )
     if use_dense(topo):
         return
     if topo.implicit_full:
@@ -448,6 +477,9 @@ def gossip_inversion_enabled(topo: Topology, cfg: RunConfig) -> bool:
 
     return (
         cfg.algorithm == "gossip"
+        # reverse-slot tables pair each edge with its mirror; quirk
+        # topologies (directed extras, self-loops, duplicates) have none
+        and not topo.asymmetric
         and os.environ.get("GOSSIP_TPU_INVERT", "1") != "0"
         and use_dense(topo)
     )

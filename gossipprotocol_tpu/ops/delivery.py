@@ -173,6 +173,12 @@ def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
         raise RoutedConfigError(
             "routed delivery: complete graph needs no edges "
             "(diffusion mixes in one round via reductions)")
+    if topo.asymmetric:
+        raise RoutedConfigError(
+            "routed delivery: the edge-permutation pairing needs a "
+            "symmetric simple graph; this reference-quirks topology "
+            "carries directed/self/duplicate entries — use "
+            "delivery='scatter'")
     n = topo.num_nodes
     offsets = np.asarray(topo.offsets, np.int64)
     indices = np.asarray(topo.indices, np.int64)

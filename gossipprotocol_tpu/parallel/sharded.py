@@ -252,9 +252,20 @@ def make_sharded_chunk_runner(
                 core, nbrs=nbrs, base_key=base_key, gids=gids, scatter=scatter1,
             )
 
-        def global_done(s):
-            unconv = jnp.sum((~s.converged & s.alive).astype(jnp.int32))
-            return jax.lax.psum(unconv, NODES_AXIS) == 0
+        if cfg.alert_quorum is not None:
+            # quorum supervisor (reference's N+1 population, see
+            # build_protocol): padding rows are pre-settled and shift
+            # the threshold — identical rule to the single-chip engine
+            quorum_eff = cfg.alert_quorum + (n_padded - n)
+
+            def global_done(s):
+                settled = jnp.sum(
+                    (s.converged | ~s.alive).astype(jnp.int32))
+                return jax.lax.psum(settled, NODES_AXIS) >= quorum_eff
+        else:
+            def global_done(s):
+                unconv = jnp.sum((~s.converged & s.alive).astype(jnp.int32))
+                return jax.lax.psum(unconv, NODES_AXIS) == 0
 
         def body(carry):
             s, _ = carry

@@ -45,6 +45,12 @@ class Topology:
     offsets: Optional[np.ndarray]
     indices: Optional[np.ndarray]
     implicit_full: bool = False
+    # Reference-quirk topologies (``--semantics reference``) may carry
+    # DIRECTED extras, self-loops, and duplicate entries — e.g. imp3D's
+    # one-way off-by-one extra neighbor (``Program.fs:258-260``). Engine
+    # features that rely on edge symmetry (gather-inverted deliveries,
+    # fanout-all diffusion, the routed plans) are gated off this flag.
+    asymmetric: bool = False
 
     def __post_init__(self):
         if self.implicit_full:

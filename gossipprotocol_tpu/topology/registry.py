@@ -56,6 +56,11 @@ def available_topologies() -> list[str]:
     return sorted(_REGISTRY)
 
 
+def canonical_name(name: str) -> str:
+    """Resolve a CLI alias to the registered builder name (no checks)."""
+    return _ALIASES.get(name.lower(), name)
+
+
 def build_topology(name: str, num_nodes: int, **kwargs) -> Topology:
     """Build topology ``name`` over ``num_nodes`` nodes.
 

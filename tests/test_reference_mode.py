@@ -1,0 +1,166 @@
+"""Reference-mode quirk rendering (VERDICT r3 missing 1-3).
+
+``--semantics reference`` exists to reproduce the reference's accidental
+behavior, not just its intended rules. These tests pin the three quirks
+round 3 left unrendered: the Actor2 keep-alive asymmetry
+(``Program.fs:224-228``), the N+1-actor population converging at N
+Alerts (``Program.fs:169-176,53``), and imp3D's off-by-one directed
+extra neighbor (``Program.fs:258-260``).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology
+from gossipprotocol_tpu.cli import main
+from gossipprotocol_tpu.engine.driver import build_protocol
+from gossipprotocol_tpu.topology.builders import (
+    add_isolated_rows,
+    build_imp3d_reference_quirks,
+)
+
+
+def run_cli(args, capsys):
+    code = main(args)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+# --- quirk 1: keep-alive asymmetry (Program.fs:200,224-228,271) ----------
+
+def test_reference_mode_full_gossip_has_no_keep_alive():
+    topo = build_topology("full", 65)
+    for topology, expect in (("full", False), ("line", True)):
+        t = build_topology(topology, 65)
+        cfg = RunConfig(algorithm="gossip", semantics="reference")
+        _, core, _, _, _ = build_protocol(t, cfg)
+        assert core.keywords["keep_alive"] is expect, topology
+    # intended mode keeps the liveness net everywhere
+    cfg = RunConfig(algorithm="gossip", semantics="intended")
+    _, core, _, _, _ = build_protocol(topo, cfg)
+    assert core.keywords["keep_alive"] is True
+
+
+# --- quirk 2: N+1 population, supervisor exits at N ----------------------
+
+def test_reference_population_line_and_full(capsys):
+    """Reference mode builds nodes+1 actors and converges at nodes
+    settled (all but one)."""
+    code, out, _ = run_cli([
+        "48", "line", "gossip", "--semantics", "reference", "--seed", "3",
+        "--chunk-rounds", "64",
+    ], capsys)
+    assert code == 0
+    assert "reference population is 49 actors" in out
+    assert "supervisor exits at 48 Alerts" in out
+    assert re.search(r"Convergence Time: \d+\.\d+ ms", out)
+    code, out, _ = run_cli([
+        "32", "full", "gossip", "--semantics", "reference", "--seed", "3",
+    ], capsys)
+    assert code == 0
+    assert "reference population is 33 actors" in out
+
+
+def test_reference_population_3d_extra_actor_is_isolated(capsys):
+    """3D/imp3D: the extra actor exists but the wiring loop never reaches
+    it — one edge-less row, excluded from the predicate."""
+    code, out, _ = run_cli([
+        "27", "3D", "gossip", "--semantics", "reference", "--seed", "1",
+        "--chunk-rounds", "64",
+    ], capsys)
+    assert code == 0
+    assert "reference population is 28 actors" in out
+    topo = add_isolated_rows(build_topology("3D", 27))
+    assert topo.num_nodes == 28
+    assert int(topo.degree[-1]) == 0
+
+
+def test_alert_quorum_ends_run_at_all_but_one():
+    """Engine-level quorum: a run over n nodes with quorum n-1 ends even
+    while one node is unconverged."""
+    import jax.numpy as jnp
+
+    from gossipprotocol_tpu import run_simulation
+
+    topo = build_topology("line", 40)
+    cfg = RunConfig(algorithm="gossip", seed=2, alert_quorum=39,
+                    chunk_rounds=32)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    conv = np.asarray(res.final_state.converged)
+    assert conv.sum() >= 39
+
+
+def test_alert_quorum_sharded_matches_single_chip(cpu_devices):
+    from gossipprotocol_tpu import run_simulation
+    from gossipprotocol_tpu.parallel import run_simulation_sharded
+
+    topo = build_topology("line", 33)
+    cfg = RunConfig(algorithm="gossip", seed=5, alert_quorum=32,
+                    chunk_rounds=32)
+    r1 = run_simulation(topo, cfg)
+    r8 = run_simulation_sharded(topo, cfg, num_devices=8, backend="cpu")
+    assert r1.rounds == r8.rounds
+    assert r1.converged and r8.converged
+
+
+# --- quirk 3: imp3D off-by-one directed extra (Program.fs:258-260) -------
+
+def test_imp3d_reference_quirks_structure():
+    topo = build_imp3d_reference_quirks(27, seed=4)
+    n = topo.num_nodes
+    assert n == 27
+    assert topo.asymmetric
+    off = np.asarray(topo.offsets)
+    idx = np.asarray(topo.indices)
+    base = build_topology("3D", 27)
+    boff = np.asarray(base.offsets)
+    # exactly one appended entry per row, lattice part untouched
+    assert np.array_equal(off, boff + np.arange(n + 1))
+    extras = idx[off[1:] - 1]
+    for i in range(n):
+        row = idx[off[i]: off[i + 1]]
+        assert np.array_equal(
+            row[:-1], np.asarray(base.indices)[boff[i]: boff[i + 1]])
+    # the off-by-one range: extra in [0, n-1) — top index never drawn
+    assert extras.max() < n - 1
+    # directed: at least one extra whose reverse entry is absent
+    def has_edge(u, v):
+        row = idx[off[u]: off[u + 1]]
+        return v in row
+    asym = sum(
+        1 for i in range(n)
+        if extras[i] != i and not has_edge(int(extras[i]), i))
+    assert asym > 0
+    # self-loops are permitted by the rule (may or may not occur at n=27)
+    assert ((extras == np.arange(n)).sum() >= 0)
+
+
+def test_imp3d_quirks_run_end_to_end(capsys):
+    code, out, _ = run_cli([
+        "27", "imp3D", "gossip", "--semantics", "reference", "--seed", "2",
+        "--chunk-rounds", "128",
+    ], capsys)
+    assert code == 0
+    assert re.search(r"Convergence Time: \d+\.\d+ ms", out)
+
+
+def test_quirk_topology_rejects_symmetry_dependent_paths():
+    from gossipprotocol_tpu.engine.driver import (
+        gossip_inversion_enabled, require_invertible,
+    )
+    from gossipprotocol_tpu.ops.delivery import (
+        RoutedConfigError, build_routed_delivery,
+    )
+
+    topo = build_imp3d_reference_quirks(27, seed=4)
+    cfg = RunConfig(algorithm="gossip")
+    assert not gossip_inversion_enabled(topo, cfg)
+    with pytest.raises(ValueError, match="symmetric"):
+        require_invertible(topo)
+    with pytest.raises(RoutedConfigError, match="symmetric"):
+        build_routed_delivery(topo)
