@@ -315,11 +315,7 @@ def build_protocol(
         # intended rule is 10 (README.md:2)
         threshold = cfg.threshold + 1 if ref else cfg.threshold
         state = gossip_init(rows, seed_node)
-        # reference mode renders Actor2's asymmetry: the keep-alive
-        # driver is started for line/3D/imp3D gossip (Program.fs:200,
-        # 271) but NOT for the full topology (Program.fs:224-228 sends
-        # no Adder) — full-topology gossip there has no liveness net
-        keep_alive = cfg.keep_alive and not (ref and topo.kind == "full")
+        keep_alive = effective_keep_alive(topo, cfg)
         core = partial(
             gossip_round, n=n, threshold=threshold, keep_alive=keep_alive,
             all_alive=all_alive, inverted=gossip_inversion_enabled(topo, cfg),
@@ -459,6 +455,19 @@ def require_invertible(topo: Topology) -> None:
         f"delivery='invert' needs the dense neighbor table: {why} — "
         "use delivery='scatter'"
     )
+
+
+def effective_keep_alive(topo: Topology, cfg: RunConfig) -> bool:
+    """The keep-alive rule actually in force (single source of truth for
+    the single-chip and sharded engines plus the stall stat).
+
+    Reference mode renders Actor2's asymmetry: the keep-alive driver is
+    started for line/3D/imp3D gossip (``Program.fs:200,271``) but NOT
+    for the full topology (``Program.fs:224-228`` sends no ``Adder``) —
+    reference-mode full-topology gossip runs without the liveness net.
+    """
+    ref = cfg.semantics == "reference"
+    return cfg.keep_alive and not (ref and topo.kind == "full")
 
 
 def gossip_inversion_enabled(topo: Topology, cfg: RunConfig) -> bool:

@@ -44,8 +44,11 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="oracle_curves")
     p.add_argument("--nodes", default=DEFAULT_NODES)
     p.add_argument("--topologies", default=DEFAULT_TOPOLOGIES)
-    p.add_argument("--seeds", type=int, default=5,
-                   help="oracle runs per point (median reported)")
+    p.add_argument("--seeds", type=int, default=25,
+                   help="oracle runs per point (median + min/max band "
+                        "reported; the published points are single runs "
+                        "of heavy-tailed quantities, so the band is the "
+                        "fair comparison)")
     p.add_argument("--out", default="oracle_curves.csv")
     args = p.parse_args(argv)
 
@@ -113,17 +116,33 @@ def main(argv=None) -> int:
         r["predicted_gossip_ms"] = (
             round(r["gossip_events_median"] / ev_per_ms, 1)
             if ev_per_ms else "")
+        r["predicted_gossip_ms_min"] = (
+            round(r["gossip_events_min"] / ev_per_ms, 1) if ev_per_ms else "")
+        r["predicted_gossip_ms_max"] = (
+            round(r["gossip_events_max"] / ev_per_ms, 1) if ev_per_ms else "")
         r["predicted_pushsum_ms"] = (
             round(r["pushsum_hops_median"] / hop_per_ms, 1)
             if hop_per_ms else "")
-        # the published line push-sum point is a single run of a
-        # heavy-tailed quantity (2-cover time; oracle seeds span ~20x),
-        # so the min column is the fair band edge to compare against
+        # the published points are SINGLE runs of heavy-tailed
+        # quantities (push-sum: the walk's 2-cover time, seeds span
+        # ~20x) read off a pixel plot — the seed band, not the median,
+        # is the fair comparison target
         r["predicted_pushsum_ms_min"] = (
             round(r["pushsum_hops_min"] / hop_per_ms, 1)
             if hop_per_ms else "")
+        r["predicted_pushsum_ms_max"] = (
+            round(r["pushsum_hops_max"] / hop_per_ms, 1)
+            if hop_per_ms else "")
         r["published_gossip_ms"] = pub_g
         r["published_pushsum_ms"] = pub_p
+        for algo, pub, rate in (("gossip", pub_g, ev_per_ms),
+                                ("pushsum", pub_p, hop_per_ms)):
+            if pub and rate:
+                lo = r[f"predicted_{algo}_ms_min"]
+                hi = r[f"predicted_{algo}_ms_max"]
+                r[f"{algo}_in_band"] = int(lo <= pub <= hi)
+            else:
+                r[f"{algo}_in_band"] = ""
 
     with open(args.out, "w", newline="") as fh:
         w = csv.DictWriter(fh, fieldnames=list(rows[0].keys()))

@@ -46,6 +46,12 @@ def _configure_asyncsim(lib: ctypes.CDLL) -> None:
         ctypes.c_int64, _I64P, _I32P, ctypes.c_uint64, ctypes.c_int32,
         ctypes.c_int64, ctypes.c_int64,
     ]
+    lib.async_gossip_cost.restype = ctypes.c_int64
+    lib.async_gossip_cost.argtypes = [
+        ctypes.c_int64, _I64P, _I32P, ctypes.c_uint64, ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
     lib.async_pushsum_walk.restype = ctypes.c_int64
     lib.async_pushsum_walk.argtypes = [
         ctypes.c_int64, _I64P, _I32P, ctypes.c_uint64, ctypes.c_int64,
@@ -164,6 +170,34 @@ def async_gossip_events(
     if ev < 0:
         raise RuntimeError("async_gossip: no convergence within max_events")
     return int(ev)
+
+
+def async_gossip_dispatch_cost(
+    topo, seed: int, threshold: int = 11, start_node: int = 0,
+    max_events: int = 100_000_000, threads: int = 8,
+) -> Optional[Tuple[int, int]]:
+    """(events, dispatcher_cost) under the reference's actor semantics.
+
+    The cost integrates a virtual dispatcher clock: one oracle sweep is
+    one round-robin pass over runnable actors; with ``threads`` worker
+    threads it costs ``max(sweep_events, threads)`` thread-time units —
+    saturated for fan-out topologies, per-event latency-bound when only
+    the rumor frontier is runnable (line gossip). Same RNG stream as
+    :func:`async_gossip_events`, so the returned events match it
+    exactly. None if the oracle library is unavailable.
+    """
+    lib = _load_async()
+    if lib is None:
+        return None
+    offsets, indices = _topo_csr64(topo)
+    cost = ctypes.c_int64(0)
+    ev = lib.async_gossip_cost(
+        topo.num_nodes, offsets, indices, np.uint64(seed & (2**64 - 1)).item(),
+        threshold, start_node, max_events, threads, ctypes.byref(cost),
+    )
+    if ev < 0:
+        raise RuntimeError("async_gossip_cost: no convergence in max_events")
+    return int(ev), int(cost.value)
 
 
 def async_pushsum_hops(

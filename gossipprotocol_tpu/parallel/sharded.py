@@ -45,6 +45,7 @@ from gossipprotocol_tpu.engine.driver import (
     RunResult,
     _drive,
     build_protocol,
+    effective_keep_alive,
     warm_start,
 )
 from gossipprotocol_tpu.parallel.mesh import (
@@ -91,7 +92,7 @@ def _sharded_core(
             gossip_round_core,
             n=n,
             threshold=cfg.threshold + 1 if ref else cfg.threshold,
-            keep_alive=cfg.keep_alive,
+            keep_alive=effective_keep_alive(topo, cfg),
             all_alive=all_alive,
             inverted=gossip_inversion_enabled(topo, cfg),
             all_sum=all_sum,
@@ -309,7 +310,8 @@ def make_sharded_chunk_runner(
             from gossipprotocol_tpu.engine.driver import gossip_spreading_count
 
             stats["spreading"] = jax.lax.psum(
-                gossip_spreading_count(final, cfg.keep_alive), NODES_AXIS
+                gossip_spreading_count(
+                    final, effective_keep_alive(topo, cfg)), NODES_AXIS
             )
         return final, stats
 

@@ -29,6 +29,9 @@ _STATE_TYPES = {"GossipState": GossipState, "PushSumState": PushSumState}
 TRAJECTORY_FIELDS = (
     "algorithm", "seed", "semantics", "threshold", "eps", "streak_target",
     "keep_alive", "predicate", "tol", "value_mode", "dtype",
+    # the stop rule is part of the trajectory: splicing a quorum run onto
+    # an all-nodes run (or vice versa) would change when the world stops
+    "alert_quorum",
     # sender/delivery variants change the trajectory too: fanout="all" is a
     # different protocol; delivery="invert" sums received mass in a
     # different float order than the scatter (both docstrings say so)

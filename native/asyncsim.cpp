@@ -46,30 +46,55 @@ struct Rng {
 
 extern "C" {
 
+int64_t async_gossip_cost(int64_t n, const int64_t* offsets,
+                          const int32_t* indices, uint64_t seed,
+                          int32_t threshold, int64_t start_node,
+                          int64_t max_events, int32_t threads,
+                          int64_t* out_cost);
+
 // Returns message events to global convergence, or -1 if max_events hit.
+// One implementation serves both entry points: the cost integral below is
+// free to compute, and a single copy keeps the RNG streams in lockstep by
+// construction (the calibration pipeline relies on the event counts of
+// the two entry points matching exactly).
 int64_t async_gossip(int64_t n, const int64_t* offsets, const int32_t* indices,
                      uint64_t seed, int32_t threshold, int64_t start_node,
                      int64_t max_events) {
+  int64_t cost = 0;
+  return async_gossip_cost(n, offsets, indices, seed, threshold, start_node,
+                           max_events, 1, &cost);
+}
+
+// Gossip with the dispatcher-cost model (VERDICT r3 #5): same event
+// semantics as async_gossip, but also integrates a virtual wall-clock.
+// One sweep = one round-robin pass of the dispatcher over runnable
+// actors; with `threads` worker threads a sweep that executes e events
+// costs max(e, threads) thread-time units / threads of wall time — a
+// saturated dispatcher (e >> threads, the full topology) advances
+// events/threads per unit, while a starved one (line gossip: only the
+// rumor frontier is runnable) pays full per-event latency. Writes the
+// integrated cost (sum of max(sweep_events, threads), i.e. wall time in
+// units of per-event service time x threads) to *out_cost and returns
+// total events (or -1 if max_events hit).
+int64_t async_gossip_cost(int64_t n, const int64_t* offsets,
+                          const int32_t* indices, uint64_t seed,
+                          int32_t threshold, int64_t start_node,
+                          int64_t max_events, int32_t threads,
+                          int64_t* out_cost) {
   std::vector<int32_t> hits(n, 0);
   std::vector<uint8_t> heard(n, 0), converged(n, 0);
-  std::vector<int64_t> active;  // nodes with a live Process1 self-loop
+  std::vector<int64_t> active;
   Rng rng{seed};
 
   heard[start_node] = 1;
   active.push_back(start_node);
-  int64_t n_converged = 0, events = 0, sweeps = 0;
+  int64_t n_converged = 0, events = 0, sweeps = 0, cost = 0;
 
-  // sweeps also bound the loop: in the keep-alive-only endgame a sweep can
-  // touch only converged nodes and advance no event counter
   while (n_converged < n && events < max_events && sweeps++ < max_events) {
-    // mailbox-fair dispatch: every active spreader sends once per sweep
-    // (the Akka dispatcher round-robins actors with queued self-messages);
-    // plus one keep-alive injection per sweep (Actor2's Process1 loop)
+    int64_t sweep_events = 0;
     for (int64_t k = 0; k < static_cast<int64_t>(active.size()); ++k) {
       int64_t i = active[k];
       if (converged[i] && hits[i] >= threshold) {
-        // reference: spreader goes silent at threshold — but keep-alive
-        // keeps the rumor moving, so just drop it from the active list
         active[k] = active.back();
         active.pop_back();
         --k;
@@ -79,24 +104,25 @@ int64_t async_gossip(int64_t n, const int64_t* offsets, const int32_t* indices,
       if (deg == 0) continue;
       int64_t j = indices[offsets[i] + rng.next(deg)];
       ++events;
-      if (converged[j]) continue;  // sender-side dict check (Program.fs:87)
+      ++sweep_events;
+      if (converged[j]) continue;
       ++hits[j];
       if (!heard[j]) {
         heard[j] = 1;
-        active.push_back(j);  // first hearing activates the spreader loop
+        active.push_back(j);
       }
       if (hits[j] >= threshold && !converged[j]) {
         converged[j] = 1;
         ++n_converged;
       }
     }
-    // keep-alive re-injection (Actor2): one random unconverged node
     if (n_converged < n) {
       int64_t tries = 0;
       while (tries++ < 8) {
         int64_t j = static_cast<int64_t>(rng.next(n));
         if (converged[j]) continue;
         ++events;
+        ++sweep_events;
         ++hits[j];
         if (!heard[j]) {
           heard[j] = 1;
@@ -109,7 +135,10 @@ int64_t async_gossip(int64_t n, const int64_t* offsets, const int32_t* indices,
         break;
       }
     }
+    cost += sweep_events > threads ? sweep_events
+                                   : static_cast<int64_t>(threads);
   }
+  *out_cost = cost;
   return n_converged >= n ? events : -1;
 }
 

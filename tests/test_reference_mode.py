@@ -95,17 +95,23 @@ def test_alert_quorum_ends_run_at_all_but_one():
     assert conv.sum() >= 39
 
 
-def test_alert_quorum_sharded_matches_single_chip(cpu_devices):
+@pytest.mark.parametrize("topology", ["line", "full"])
+def test_alert_quorum_sharded_matches_single_chip(cpu_devices, topology):
+    """Quorum supervisor AND the reference full-topology keep-alive
+    asymmetry must take the same trajectory sharded as single-chip
+    (the 'full' case exercises effective_keep_alive in both engines —
+    found by code review)."""
     from gossipprotocol_tpu import run_simulation
     from gossipprotocol_tpu.parallel import run_simulation_sharded
 
-    topo = build_topology("line", 33)
+    topo = build_topology(topology, 33)
     cfg = RunConfig(algorithm="gossip", seed=5, alert_quorum=32,
-                    chunk_rounds=32)
+                    semantics="reference", chunk_rounds=64,
+                    max_rounds=4096)
     r1 = run_simulation(topo, cfg)
     r8 = run_simulation_sharded(topo, cfg, num_devices=8, backend="cpu")
     assert r1.rounds == r8.rounds
-    assert r1.converged and r8.converged
+    assert r1.converged == r8.converged
 
 
 # --- quirk 3: imp3D off-by-one directed extra (Program.fs:258-260) -------
