@@ -1,0 +1,114 @@
+"""Bisect the routed round's 13.6 GB temp blowup (one build, many compiles).
+
+Compiles subprograms of the routed diffusion round at --nodes scale and
+prints each one's XLA temp size: each plan chain alone, the expand, the
+reduce, the full matvec, one bare round, and the 4-round chunk loop.
+
+Usage: python experiments/routed_mem_bisect.py [--nodes 2000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu import build_topology
+from gossipprotocol_tpu.engine.driver import (
+    RunConfig, build_protocol, device_arrays, make_chunk_runner,
+)
+from gossipprotocol_tpu.ops.exec import apply_plan
+
+
+def report(name, lowered):
+    c = lowered.compile()
+    ma = c.memory_analysis()
+    print(f"{name:28s} args {ma.argument_size_in_bytes/1e9:6.2f} GB  "
+          f"temps {ma.temp_size_in_bytes/1e9:6.2f} GB", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=2_000_000)
+    args = ap.parse_args()
+    topo = build_topology("powerlaw", args.nodes, seed=7, m=4)
+    cfg = RunConfig(algorithm="push-sum", fanout="all", predicate="global",
+                    tol=1e-4, seed=11, delivery="routed")
+    t0 = time.perf_counter()
+    rd = device_arrays(topo, cfg)
+    print(f"plan build: {time.perf_counter()-t0:.0f}s", flush=True)
+    n = topo.num_nodes
+
+    x = jnp.zeros(rd.plan_m[0].m_in_f32, jnp.float32)
+
+    def chain(plans, x):
+        for p in plans:
+            pad = p.m_in_f32 - x.shape[0]
+            x = apply_plan(p, jnp.pad(x, (0, pad)) if pad else x)
+        return x
+
+    # plans must be jit ARGUMENTS (registered pytrees): closing over them
+    # embeds GBs of tables as constants and stalls tracing (measured)
+    report("plan_m[0] alone",
+           jax.jit(lambda p, v: apply_plan(p, v)).lower(rd.plan_m[0], x))
+    report("plan_m chain (2)",
+           jax.jit(lambda ps, v: chain(ps, v)).lower(rd.plan_m, x))
+    xn = jnp.zeros(rd.plan_in[0].m_in_f32, jnp.float32)
+    report("plan_in chain (2)",
+           jax.jit(lambda ps, v: chain(ps, v)).lower(rd.plan_in, xn))
+
+    xs = jnp.zeros(n, jnp.float32)
+
+    def expand_now(r, cls):
+        from gossipprotocol_tpu.ops import classops as co
+        segs = []
+        off = 0
+        for c, n_c, start, reg_rows, cap in r.classes:
+            node_pairs = jax.lax.dynamic_slice_in_dim(cls, 2 * off, 2 * n_c)
+            node_pairs = jnp.pad(node_pairs, (0, 2 * (cap - n_c)))
+            if 2 * c <= 128:
+                segs.append(co.class_expand_small(node_pairs, c))
+            else:
+                segs.append(co.class_expand_big(node_pairs, c))
+            off += n_c
+        return jnp.concatenate(segs) * r.realmask
+
+    def reduce_now(r, f):
+        from gossipprotocol_tpu.ops import classops as co
+        ys = []
+        for c, n_c, start, reg_rows, cap in r.classes:
+            region = jax.lax.dynamic_slice_in_dim(f, 2 * start,
+                                                  reg_rows * 128)
+            if 2 * c <= 128:
+                packed = co.class_reduce_small(region, c)
+            else:
+                packed = co.class_reduce_big(region, c)
+            ys.append(packed[: 2 * n_c])
+        return jnp.concatenate(ys)
+
+    clsv = jnp.zeros(rd.nu * 2, jnp.float32)
+    report("expand only", jax.jit(expand_now).lower(rd, clsv))
+    fin = jnp.zeros(rd.m_pairs * 2, jnp.float32)
+    report("reduce only", jax.jit(reduce_now).lower(rd, fin))
+    report("full matvec",
+           jax.jit(lambda r, a, b: r.matvec(a, b)).lower(rd, xs, xs))
+
+    state, core, done, extra, _fl = build_protocol(topo, cfg)
+    report("one round",
+           jax.jit(lambda s, r: core(s, r, jax.random.PRNGKey(0))).lower(
+               state, rd))
+    runner = make_chunk_runner(core, done, extra)
+    report("chunk loop (limit arg)",
+           runner.lower(state, rd, jax.random.PRNGKey(0), jnp.int32(4)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,214 @@
+"""Pallas expand/reduce over the class-padded delivery layout.
+
+The routed delivery's F layout stores each node's c pair slots
+contiguously, grouped by class.  The natural XLA spelling of the reduce
+— ``seg.reshape(n_c, c, 2).sum(1)`` per class — is a memory catastrophe
+on TPU: any shape ending in small minor dims is tiled to (8, 128), so a
+``[n_c, 4, 2]`` f32 intermediate occupies up to 128x its data (measured:
+13.4 GB of XLA temporaries at 2M nodes — the 10M HBM OOM).  These
+kernels keep everything in flat ``[rows, 128]`` views and do the
+per-node arithmetic with lane rolls and static lane gathers — ops
+Mosaic is good at.
+
+Layout contract (enforced by ops/delivery.py): every class region
+covers whole 128-f32 rows, padded to a multiple of ``BLK`` rows with
+phantom node slots (the routing plan maps them from nothing, so they
+read as exact zeros; their reduce outputs sit at the region tail and
+are sliced off).
+
+Small classes (2c <= 128 f32 lanes): a row holds 128/(2c) node runs.
+  reduce: stride-2 lane folds (shift 2, 4, ..., c) leave each run's
+          s-sum in its start lane and w-sum in start+1; a static lane
+          gather packs them left.
+  expand: a static lane gather replicates each packed pair across its
+          run (lane j reads lane 2*(j // (2c)) + j % 2).
+Big classes (2c > 128): a node run spans q = 2c/128 whole rows.
+  reduce: full-row stride-2 fold to per-row (s, w) partials, then the
+          q rows accumulate into one output block (grid revisiting).
+  expand: each output row block reads its node's packed pair and
+          broadcasts it across the lanes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+LANES = 128
+BLK = 256          # rows per small-class grid step (128 KB blocks)
+BIGQ = 1024        # max rows per big-class grid step (512 KB blocks)
+
+
+def _fold_gather_idx(shape, two_c: int):
+    """In-kernel lane gather packing run-start (s, w) lanes left.
+
+    idx[j] = (j // 2) * 2c + (j % 2) for the packed prefix; the modulo
+    keeps the sliced-away tail in bounds. Built from iota because Pallas
+    kernels cannot capture host constants.
+    """
+    col = jax.lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
+    return ((col // 2) * two_c + (col % 2)) % LANES
+
+
+def _spread_gather_idx(shape, two_c: int):
+    """In-kernel lane gather replicating packed pairs across runs."""
+    col = jax.lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
+    return 2 * (col // two_c) + (col % 2)
+
+
+def class_reduce_small(region: jax.Array, c: int,
+                       interpret: bool = False) -> jax.Array:
+    """Per-run (s, w) sums of a small-class region.
+
+    ``region``: f32 [rows * 128] flat, rows % BLK == 0, runs of 2c lanes.
+    Returns f32 [rows * 128 // c] (packed pair sums, row-major).
+    """
+    two_c = 2 * c
+    assert LANES % two_c == 0
+    out_lanes = LANES // c
+    view = region.reshape(-1, LANES)
+    rows = view.shape[0]
+    assert rows % BLK == 0, (rows, BLK)
+    def kernel(x_ref, o_ref):
+        acc = x_ref[...]
+        sh = 2
+        while sh < two_c:
+            acc = acc + jnp.roll(acc, -sh, axis=1)
+            sh *= 2
+        idx = _fold_gather_idx(acc.shape, two_c)
+        packed = jnp.take_along_axis(acc, idx, axis=1)
+        o_ref[...] = packed[:, :out_lanes]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // BLK,),
+        out_shape=jax.ShapeDtypeStruct((rows, out_lanes), region.dtype),
+        in_specs=[pl.BlockSpec((BLK, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((BLK, out_lanes), lambda i: (i, 0)),
+        interpret=interpret,
+    )(view)
+    return out.reshape(-1)
+
+
+def class_expand_small(packed: jax.Array, c: int,
+                       interpret: bool = False) -> jax.Array:
+    """Inverse packing: replicate each packed pair across its 2c-lane run.
+
+    ``packed``: f32 [rows * 128 // c]; returns f32 [rows * 128].
+    """
+    two_c = 2 * c
+    in_lanes = LANES // c
+    view = packed.reshape(-1, in_lanes)
+    rows = view.shape[0]
+    assert rows % BLK == 0, (rows, BLK)
+    def kernel(x_ref, o_ref):
+        x = x_ref[...]
+        if in_lanes == LANES:      # c == 1: runs are already pair-wide
+            wide = x
+        else:
+            wide = jnp.concatenate(
+                [x, jnp.zeros((x.shape[0], LANES - in_lanes), x.dtype)],
+                axis=1)
+        idx = _spread_gather_idx(wide.shape, two_c)
+        o_ref[...] = jnp.take_along_axis(wide, idx, axis=1)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // BLK,),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), packed.dtype),
+        in_specs=[pl.BlockSpec((BLK, in_lanes), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((BLK, LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(view)
+    return out.reshape(-1)
+
+
+def class_reduce_big(region: jax.Array, c: int,
+                     interpret: bool = False) -> jax.Array:
+    """Reduce runs spanning q = 2c/128 whole rows each.
+
+    ``region``: f32 [n_c * q * 128] flat. Returns f32 [2 * n_c]
+    (packed (s, w) per node — padded to a [n_c, 128] row each on the
+    way out; tiny for the hub classes this path serves).
+    """
+    q = (2 * c) // LANES
+    assert q * LANES == 2 * c
+    view = region.reshape(-1, LANES)
+    n_c = view.shape[0] // q
+    qb = min(q, BIGQ)
+    steps = -(-q // qb)
+    assert qb * steps == q, (q, qb)
+
+    n_out = -(-n_c // 8) * 8   # sublane-aligned output rows
+
+    def kernel(x_ref, o_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        acc = x_ref[...]
+        sh = 2
+        while sh < LANES:
+            acc = acc + jnp.roll(acc, -sh, axis=1)
+            sh *= 2
+        partial = jnp.sum(acc[:, :2], axis=0)          # [2]
+        row = jnp.pad(partial, (0, LANES - 2))[None, :]
+
+        @pl.when(j == 0)
+        def _init():
+            o_ref[pl.ds(i, 1), :] = row
+
+        @pl.when(j != 0)
+        def _acc():
+            o_ref[pl.ds(i, 1), :] = o_ref[pl.ds(i, 1), :] + row
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_c, steps),
+        out_shape=jax.ShapeDtypeStruct((n_out, LANES), region.dtype),
+        in_specs=[pl.BlockSpec((qb, LANES),
+                               lambda i, j: (i * (q // qb) + j, 0))],
+        # whole output resident (hub classes have few nodes); rows
+        # addressed dynamically — a (1, 128) block would violate the
+        # 8-sublane block rule
+        out_specs=pl.BlockSpec((n_out, LANES), lambda i, j: (0, 0)),
+        interpret=interpret,
+    )(view)
+    return out[:n_c, :2].reshape(-1)
+
+
+def class_expand_big(pairs: jax.Array, c: int,
+                     interpret: bool = False) -> jax.Array:
+    """Replicate each node pair across its q = 2c/128 rows.
+
+    ``pairs``: f32 [2 * n_c]; returns f32 [n_c * q * 128].
+    """
+    q = (2 * c) // LANES
+    n_c = pairs.shape[0] // 2
+    n_in = -(-n_c // 8) * 8    # sublane-aligned input rows
+    src = jnp.pad(pairs.reshape(n_c, 2),
+                  ((0, n_in - n_c), (0, LANES - 2)))
+    qb = min(q, BIGQ)
+    steps = -(-q // qb)
+    assert qb * steps == q, (q, qb)
+
+    def kernel(x_ref, o_ref):
+        i = pl.program_id(0)
+        s = x_ref[i, 0]                        # scalar reads
+        w = x_ref[i, 1]
+        col = jax.lax.broadcasted_iota(jnp.int32, o_ref.shape, 1)
+        o_ref[...] = jnp.where(col % 2 == 0, s, w)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_c, steps),
+        out_shape=jax.ShapeDtypeStruct((n_c * q, LANES), pairs.dtype),
+        # whole packed input resident; rows addressed dynamically
+        in_specs=[pl.BlockSpec((n_in, LANES), lambda i, j: (0, 0))],
+        out_specs=pl.BlockSpec((qb, LANES),
+                               lambda i, j: (i * (q // qb) + j, 0)),
+        interpret=interpret,
+    )(src)
+    return out.reshape(-1)

@@ -106,25 +106,38 @@ def _register_delivery():
     jax.tree_util.register_pytree_node(RoutedDelivery, flatten, unflatten)
 
 
-def _apply_chain(plans, x, take_f32, interpret):
+def _apply_chain(plans, x, interpret, take_f32=None):
     """Run ``x`` through consecutive plans, then slice to ``take_f32``."""
     for p in plans:
         pad = p.m_in_f32 - x.shape[0]
-        x = apply_plan(p, jnp.pad(x, (0, pad)) if pad else x, interpret)
-    return x[:take_f32]
+        if pad < 0:
+            x = x[: p.m_in_f32]
+        elif pad:
+            x = jnp.pad(x, (0, pad))
+        x = apply_plan(p, x, interpret)
+    return x if take_f32 is None else x[:take_f32]
 
 
 class RoutedDelivery(NamedTuple):  # registered below: geometry static
-    """Device-side routed delivery for one topology (a pytree)."""
+    """Device-side routed delivery for one topology (a pytree).
+
+    Everything on the device side is FLAT f32: any logical ``[*, 2]`` or
+    ``[*, c, 2]`` tensor would be tiled to minor dims (8, 128) on TPU —
+    up to 128x its data in HBM (measured 13.4 GB of XLA temporaries at
+    2M nodes). Pair interleaving, the class broadcast-expand, and the
+    per-node reduce therefore run as Pallas lane kernels
+    (:mod:`gossipprotocol_tpu.ops.classops`).
+    """
 
     n: int                       # real nodes
     nu: int                      # nodes with degree > 0
-    m_pairs: int                 # class-layout pair slots
-    classes: Tuple[Tuple[int, int, int], ...]  # (c, n_c, start_pair)
+    m_pairs: int                 # class-layout pair slots (aligned)
+    # (c, n_c, start_pair, region_rows, node_capacity) per class
+    classes: Tuple[Tuple[int, int, int, int, int], ...]
     plan_in: Tuple[DevicePlan, ...]   # natural -> class order (chained)
     plan_m: Tuple[DevicePlan, ...]    # the edge permutation
     plan_out: Tuple[DevicePlan, ...]  # class -> natural order (chained)
-    realmask: jax.Array          # f32 [m_pairs] 1.0 on real slots
+    realmask: jax.Array          # f32 [2 * m_pairs] 1.0 on real slots
     degree: jax.Array            # int32 [n]
 
     def matvec(self, xs: jax.Array, xw: jax.Array, interpret: bool = False):
@@ -133,30 +146,40 @@ class RoutedDelivery(NamedTuple):  # registered below: geometry static
         Inputs may carry engine padding rows beyond ``n`` (ignored — pad
         rows have no edges); outputs are zero there.
         """
+        from gossipprotocol_tpu.ops import classops as co
+
         rows = xs.shape[0]
-        pairs = jnp.stack([xs[: self.n], xw[: self.n]], -1).reshape(-1)
-        cls = _apply_chain(self.plan_in, pairs, self.nu * 2,
-                           interpret).reshape(self.nu, 2)
+        flat = jnp.concatenate([xs[: self.n], xw[: self.n]])
+        cls = _apply_chain(self.plan_in, flat, interpret,
+                           take_f32=self.nu * 2)
         segs = []
         off = 0
-        for c, n_c, start in self.classes:
-            seg = jax.lax.dynamic_slice_in_dim(cls, off, n_c, 0)
-            segs.append(jnp.broadcast_to(
-                seg[:, None, :], (n_c, c, 2)).reshape(-1, 2))
+        for c, n_c, start, reg_rows, cap in self.classes:
+            node_pairs = jax.lax.dynamic_slice_in_dim(cls, 2 * off, 2 * n_c)
+            node_pairs = jnp.pad(node_pairs, (0, 2 * (cap - n_c)))
+            if 2 * c <= 128:
+                segs.append(co.class_expand_small(node_pairs, c, interpret))
+            else:
+                segs.append(co.class_expand_big(node_pairs, c, interpret))
             off += n_c
-        e1 = jnp.concatenate(segs, 0) * self.realmask[:, None]
-        f = _apply_chain(self.plan_m, e1.reshape(-1), self.m_pairs * 2,
-                         interpret).reshape(self.m_pairs, 2)
+        e1 = jnp.concatenate(segs) * self.realmask
+        f = _apply_chain(self.plan_m, e1, interpret,
+                         take_f32=self.m_pairs * 2)
         ys = []
-        for c, n_c, start in self.classes:
-            seg = jax.lax.dynamic_slice_in_dim(f, start, n_c * c, 0)
-            ys.append(seg.reshape(n_c, c, 2).sum(1))
-        yf = jnp.concatenate(ys, 0).reshape(-1)
-        nat = _apply_chain(self.plan_out, yf, self.n * 2,
-                           interpret).reshape(self.n, 2)
-        if rows > self.n:
-            nat = jnp.pad(nat, ((0, rows - self.n), (0, 0)))
-        return nat[:, 0], nat[:, 1]
+        for c, n_c, start, reg_rows, cap in self.classes:
+            region = jax.lax.dynamic_slice_in_dim(
+                f, 2 * start, reg_rows * 128)
+            if 2 * c <= 128:
+                packed = co.class_reduce_small(region, c, interpret)
+            else:
+                packed = co.class_reduce_big(region, c, interpret)
+            ys.append(packed[: 2 * n_c])
+        yf = jnp.concatenate(ys)
+        nat = _apply_chain(self.plan_out, yf, interpret,
+                           take_f32=2 * self.n)
+        out_s = jnp.pad(nat[: self.n], (0, rows - self.n))
+        out_w = jnp.pad(nat[self.n:], (0, rows - self.n))
+        return out_s, out_w
 
 
 _register_delivery()
@@ -184,6 +207,12 @@ def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
     indices = np.asarray(topo.indices, np.int64)
     degree = np.diff(offsets)
     cls = _ceil_pow2(degree)
+    # classes 128/256 (runs of 2-4 whole rows) sit between the lane
+    # kernels (runs within one row) and the row kernels (runs of >= 8
+    # rows, the Mosaic sublane-block minimum) — merge them up to 512.
+    # Cost: <= 8x slot padding on the degree-65..256 band, ~0.4% of a
+    # BA graph's nodes; ER never has such degrees.
+    cls[(cls > 64) & (cls < 512)] = 512
     cls[degree == 0] = 0
 
     # class-major node order; WITHIN each class the order is shuffled
@@ -208,37 +237,61 @@ def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
     rank[order] = np.arange(nu)
 
     c_sorted = cls[order]
-    # per-node slot starts in the class layout
-    slot_count = c_sorted
-    starts = np.r_[0, np.cumsum(slot_count)]
-    m_pairs = int(starts[-1])
+    # class segment table with Pallas-aligned regions (see ops/classops):
+    # small classes (2c <= 128 lanes) pad their region to BLK-row
+    # multiples with phantom node slots; big classes cover whole rows by
+    # construction. Phantom/class-pad slots are -1 (never routed) and
+    # read as exact zeros out of the final pass.
+    from gossipprotocol_tpu.ops.classops import BLK
 
-    # class segment table (c, n_c, start_pair)
     cb = np.r_[0, np.flatnonzero(np.diff(c_sorted)) + 1, nu]
-    classes = tuple(
-        (int(c_sorted[i]), int(j - i), int(starts[i]))
-        for i, j in zip(cb[:-1], cb[1:]))
+    classes = []
+    node_start_pair = np.zeros(nu, np.int64)
+    cursor = 0
+    for i, j in zip(cb[:-1], cb[1:]):
+        c = int(c_sorted[i])
+        n_c = int(j - i)
+        if 2 * c <= 128:
+            rows = -(-(n_c * 2 * c) // 128)
+            rows = -(-rows // BLK) * BLK
+            cap = rows * 128 // (2 * c)
+        else:
+            q = (2 * c) // 128
+            rows = n_c * q
+            cap = n_c
+        node_start_pair[i:j] = cursor + np.arange(n_c, dtype=np.int64) * c
+        classes.append((c, n_c, int(cursor), int(rows), int(cap)))
+        cursor += cap * c
+    classes = tuple(classes)
+    m_pairs = int(cursor)
 
     if progress:
         progress(f"routed delivery: n={n} nu={nu} m_pairs={m_pairs} "
-                 f"classes={[(c, k) for c, k, _ in classes]}")
+                 f"classes={[(c, k) for c, k, *_ in classes]}")
 
-    # ---- plan_in: natural -> class order --------------------------------
-    # Chained through a stride scramble: node ids correlate with degree
-    # (BA growth order), so the class permutation clusters sources into
-    # narrow tile bands — built directly, its radix cells concentrate
-    # (measured K=62 final merge at 1M, a VMEM OOM). A multiplicative
-    # stride rho(i) = i*P mod m spreads every contiguous band perfectly
-    # uniformly, and the composition class_order o rho^-1 inherits the
-    # spread; two well-behaved plans replace one pathological one.
-    src_in = order.copy()                    # out slot k <- node order[k]
-    plans_in = _chained_plans(src_in, m_in=n, progress=progress)
+    # ---- plan_in: [xs | xw] concat -> interleaved class order -----------
+    # unit=1 f32 routing: out slot 2r takes s of the r-th class node
+    # (input slot order[r]), slot 2r+1 its w (slot n + order[r]) — the
+    # plan absorbs the pair interleaving, which has no other
+    # layout-safe spelling on TPU (a [n, 2] stack pads 2 -> 128 lanes,
+    # and Mosaic rejects the lane<->sublane shape casts a kernel
+    # spelling needs). Chained through a stride scramble: node ids
+    # correlate with degree (BA growth order), so the class permutation
+    # clusters sources into narrow tile bands — built directly, its
+    # radix cells concentrate (measured K=62 final merge at 1M, a VMEM
+    # OOM). rho(i) = i*P mod m spreads every contiguous band uniformly
+    # and the composition inherits the spread.
+    src_in = np.empty(2 * nu, np.int64)
+    src_in[0::2] = order
+    src_in[1::2] = n + order
+    plans_in = _chained_plans(src_in, m_in=2 * n, progress=progress,
+                              unit=1)
 
     # ---- plan_m: edge permutation on the class layout -------------------
-    # directed edge e (row u, slot k): E1 slot = starts[rank[u]] + k
+    # directed edge e (row u, slot k): E1 slot = node_start_pair[rank[u]] + k
     # its value lands at (v, rank of reverse edge v->u in v's row)
     src_nodes = np.repeat(np.arange(n, dtype=np.int64), degree)
-    e1_slot = starts[rank[src_nodes]] + (
+    e1_slot = node_start_pair[rank[src_nodes]] + (
         np.arange(len(indices), dtype=np.int64) - offsets[src_nodes])
     # reverse-edge rank: position of (v, u) in v's row, via lexsort pairing
     fwd = np.lexsort((indices, src_nodes))   # sorted (u, v) — CSR is sorted
@@ -249,18 +302,15 @@ def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
     reverse_of[fwd] = rev
     in_rank = np.empty(len(indices), np.int64)
     in_rank[reverse_of] = np.arange(len(indices)) - offsets[src_nodes]
-    f_slot = starts[rank[indices]] + in_rank
+    f_slot = node_start_pair[rank[indices]] + in_rank
     src_of_m = np.full(m_pairs, -1, np.int64)
     src_of_m[f_slot] = e1_slot
-    # class pads carry zeros; pair them by a seeded RANDOM permutation —
-    # identity pairing would add a block-diagonal component to the
-    # permutation and re-concentrate the radix cells the within-class
-    # shuffle above just spread (same capacity blowup)
-    padmask = np.ones(m_pairs, bool)
-    padmask[f_slot] = False
-    pads = np.nonzero(padmask)[0]
-    src_of_m[pads] = pads[rng.permutation(pads.size)]
-    realmask = (~padmask).astype(np.float32)
+    # every non-real slot (class pad, phantom, alignment) stays -1: the
+    # final routing pass emits exact zeros for don't-care slots, which
+    # is precisely what pads must deliver — no pad flows to route at all
+    realmask_pairs = np.zeros(m_pairs, bool)
+    realmask_pairs[e1_slot] = True
+    realmask = np.repeat(realmask_pairs, 2).astype(np.float32)
     # Chained like the N-plans: even with the within-class shuffle, a
     # hub's out-slot tiles target single class regions (its neighbors'
     # classes aren't uniform), skewing bucket loads ~7x on power-law
@@ -270,13 +320,15 @@ def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
     # recover that pass and are the noted follow-up.
     plans_m = _chained_plans(src_of_m, m_in=m_pairs, progress=progress)
 
-    # ---- plan_out: class order -> natural (chained, see plan_in) --------
+    # ---- plan_out: interleaved class order -> [s | w] concat ------------
     # degree-0 nodes receive nothing: -1 slots read as exact zeros (the
     # final pass accumulates from zero under an all-false mask)
-    src_out = np.full(n, -1, np.int64)
+    src_out = np.full(2 * n, -1, np.int64)
     has = degree > 0
-    src_out[has] = rank[has]
-    plans_out = _chained_plans(src_out, m_in=nu, progress=progress)
+    src_out[:n][has] = 2 * rank[has]
+    src_out[n:][has] = 2 * rank[has] + 1
+    plans_out = _chained_plans(src_out, m_in=2 * nu, progress=progress,
+                               unit=1)
 
     return RoutedDelivery(
         n=n, nu=nu, m_pairs=m_pairs, classes=classes,
@@ -307,7 +359,8 @@ def _check_geometry(name: str, p) -> None:
         )
 
 
-def _chained_plans(src_of: np.ndarray, m_in: int, progress=None):
+def _chained_plans(src_of: np.ndarray, m_in: int, progress=None,
+                   unit: int = 2):
     """Two well-spread plans implementing one structured permutation.
 
     rho(i) = i * P mod m (P coprime to m): every contiguous input band
@@ -321,10 +374,10 @@ def _chained_plans(src_of: np.ndarray, m_in: int, progress=None):
     rho = (k * p_stride) % m                 # out slot j <- in slot rho[j]
     rho_inv = np.empty(m, np.int64)
     rho_inv[rho] = k
-    plan1 = plan_mod.build_route_plan(rho, m_in=m, unit=2,
+    plan1 = plan_mod.build_route_plan(rho, m_in=m, unit=unit,
                                       progress=progress)
     src2 = np.where(src_of >= 0, rho_inv[np.clip(src_of, 0, m - 1)], -1)
-    plan2 = plan_mod.build_route_plan(src2, m_in=m, unit=2,
+    plan2 = plan_mod.build_route_plan(src2, m_in=m, unit=unit,
                                       progress=progress)
     _check_geometry("stride plan", plan1)
     _check_geometry("descrambled plan", plan2)

@@ -32,13 +32,17 @@ class DeviceStage(NamedTuple):
     cr: int
     o: int
     tau_slab: int
-    idx: jax.Array  # int8 [p*tau_in, o, 3, 128, 128]
+    idx: jax.Array  # int8 [p*tau_in, o, 3, 128, 64] for unit=2 plans
+                    # (pair-redundant entries dropped; component 1
+                    # transposed — see device_plan.shrink), else
+                    # [p*tau_in, o, 3, 128, 128]
 
 
 class DeviceFinal(NamedTuple):
     k: int
     idx: jax.Array   # int8 [nt_out, k, 3, 128, 128]
-    mask: jax.Array  # uint8 [nt_out, k, 128, 128]
+    mask: jax.Array  # uint8 [nt_out, k, 128, 16] — bitpacked source-k
+                     # selector (bit j of byte j//8; 8x smaller args)
 
 
 class DevicePlan(NamedTuple):
@@ -60,29 +64,71 @@ class DevicePlan(NamedTuple):
 
 
 def device_plan(plan: RoutePlan) -> DevicePlan:
+    def shrink(idx):
+        # unit=2: odd entries are derivable (see _widen_pair_idx). The
+        # lane-stage arrays (components 0, 2) halve along lanes; the
+        # row-stage array (component 1) has its redundancy along rows,
+        # so it is transposed into the same [128, 64] shape.
+        if plan.unit != 2:
+            return idx
+        out = np.empty(idx.shape[:-2] + (128, 64), idx.dtype)
+        out[..., 0, :, :] = idx[..., 0, :, 0::2]
+        out[..., 2, :, :] = idx[..., 2, :, 0::2]
+        out[..., 1, :, :] = np.swapaxes(idx[..., 1, :, :], -1, -2)[..., 0::2]
+        return out
+
     stages = tuple(
         DeviceStage(st.p, st.tau_in, st.b, st.cr, st.o, st.tau_slab,
-                    jnp.asarray(st.idx))
+                    jnp.asarray(shrink(st.idx)))
         for st in plan.stages)
-    fin = DeviceFinal(plan.final.k, jnp.asarray(plan.final.idx),
-                      jnp.asarray(plan.final.mask))
+    m = np.asarray(plan.final.mask, np.uint8).reshape(
+        plan.nt_out, plan.final.k, 128, 16, 8)
+    packed = np.zeros(m.shape[:-1], np.uint8)
+    for b in range(8):
+        packed |= (m[..., b] << b).astype(np.uint8)
+    fin = DeviceFinal(plan.final.k, jnp.asarray(shrink(plan.final.idx)),
+                      jnp.asarray(packed))
     return DevicePlan(plan.unit, plan.nt_in, plan.nt_out, stages, fin)
 
 
-def _route_one(x, i1, i2, i3):
-    a = jnp.take_along_axis(x, i1.astype(jnp.int32), axis=1)
-    b = jnp.take_along_axis(a.T, i2.astype(jnp.int32), axis=1)
-    return jnp.take_along_axis(b.T, i3.astype(jnp.int32), axis=1)
+def _widen_pair_idx(half, add_parity):
+    """[128, 64] int8 -> [128, 128] int32 lane indices (unit=2 plans).
+
+    Pair-aligned gathers touch lanes (2q, 2q+1) together, so only the
+    even-lane entry is stored (half idx args, ~4 GB at 10M). Lane c
+    reads half[c // 2] (+ c % 2 for the lane-stage indices).
+    """
+    col = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+    wide = jnp.concatenate(
+        [half, jnp.zeros((128, 64), jnp.int8)], axis=1).astype(jnp.int32)
+    v = jnp.take_along_axis(wide, col // 2, axis=1)
+    return v + (col % 2) if add_parity else v
 
 
-def _stage_call(st: DeviceStage, cur: jax.Array, interpret: bool):
+def _route_one(x, i1, i2, i3, unit):
+    if unit == 2:
+        i1 = _widen_pair_idx(i1, True)
+        # i2's redundancy is along ROWS (both f32 columns of a pair
+        # carry one row move), so it is stored transposed: undo here
+        i2 = _widen_pair_idx(i2, False).T
+        i3 = _widen_pair_idx(i3, True)
+    else:
+        i1, i2, i3 = (v.astype(jnp.int32) for v in (i1, i2, i3))
+    a = jnp.take_along_axis(x, i1, axis=1)
+    b = jnp.take_along_axis(a.T, i2, axis=1)
+    return jnp.take_along_axis(b.T, i3, axis=1)
+
+
+def _stage_call(st: DeviceStage, cur: jax.Array, interpret: bool,
+                unit: int):
     o_count, b, cr = st.o, st.b, st.cr
+    iw = st.idx.shape[-1]
 
     def kernel(x_ref, idx_ref, o_ref):
         x = x_ref[0]
         parts = [
             _route_one(x, idx_ref[0, oi, 0], idx_ref[0, oi, 1],
-                       idx_ref[0, oi, 2])
+                       idx_ref[0, oi, 2], unit)
             for oi in range(o_count)
         ]
         rows = jnp.concatenate(parts, 0)[: b * cr]
@@ -97,7 +143,7 @@ def _stage_call(st: DeviceStage, cur: jax.Array, interpret: bool):
         out_shape=out_shape,
         in_specs=[
             pl.BlockSpec((1, 128, 128), lambda p, i: (p * tau + i, 0, 0)),
-            pl.BlockSpec((1, o_count, 3, 128, 128),
+            pl.BlockSpec((1, o_count, 3, 128, iw),
                          lambda p, i: (p * tau + i, 0, 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, st.b, 1, st.cr, 128),
@@ -108,16 +154,26 @@ def _stage_call(st: DeviceStage, cur: jax.Array, interpret: bool):
 
 
 def _final_call(fin: DeviceFinal, nt_out: int, cur: jax.Array,
-                interpret: bool):
+                interpret: bool, unit: int):
     k = fin.k
+    iw = fin.idx.shape[-1]
     regions = cur.reshape(-1, k, 128, 128)
 
     def kernel(x_ref, idx_ref, m_ref, o_ref):
         acc = jnp.zeros((128, 128), cur.dtype)
+        col = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
         for kk in range(k):
             y = _route_one(x_ref[0, kk], idx_ref[0, kk, 0],
-                           idx_ref[0, kk, 1], idx_ref[0, kk, 2])
-            acc = jnp.where(m_ref[0, kk] != 0, y, acc)
+                           idx_ref[0, kk, 1], idx_ref[0, kk, 2], unit)
+            # unpack bit (col % 8) of packed byte (col // 8): a
+            # duplicating lane gather widens [128,16] -> [128,128]
+            bytes_ = jnp.take_along_axis(
+                jnp.concatenate([m_ref[0, kk],
+                                 jnp.zeros((128, 112), jnp.uint8)], 1)
+                .astype(jnp.int32),
+                col // 8, axis=1)
+            bit = (bytes_ >> (col % 8)) & 1
+            acc = jnp.where(bit != 0, y, acc)
         o_ref[0] = acc
 
     return pl.pallas_call(
@@ -126,8 +182,8 @@ def _final_call(fin: DeviceFinal, nt_out: int, cur: jax.Array,
         out_shape=jax.ShapeDtypeStruct((nt_out, 128, 128), cur.dtype),
         in_specs=[
             pl.BlockSpec((1, k, 128, 128), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1, k, 3, 128, 128), lambda i: (i, 0, 0, 0, 0)),
-            pl.BlockSpec((1, k, 128, 128), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, k, 3, 128, iw), lambda i: (i, 0, 0, 0, 0)),
+            pl.BlockSpec((1, k, 128, 16), lambda i: (i, 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 128, 128), lambda i: (i, 0, 0)),
         interpret=interpret,
@@ -147,6 +203,6 @@ def apply_plan(dp: DevicePlan, x: jax.Array, interpret: bool = False
     """
     cur = x.reshape(dp.nt_in, 128, 128)
     for st in dp.stages:
-        cur = _stage_call(st, cur, interpret)
-    out = _final_call(dp.final, dp.nt_out, cur, interpret)
+        cur = _stage_call(st, cur, interpret, dp.unit)
+    out = _final_call(dp.final, dp.nt_out, cur, interpret, dp.unit)
     return out.reshape(-1)
