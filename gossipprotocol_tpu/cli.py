@@ -49,6 +49,7 @@ def _build_config(args, algo, fault_plan, jnp, alert_quorum=None):
         predicate=args.predicate,
         tol=args.tol,
         fanout=args.fanout,
+        edge_chunks=args.edge_chunks,
         delivery=args.delivery,
         value_mode=args.value_mode,
         max_rounds=args.max_rounds,
@@ -242,6 +243,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "DROPPED, not redrawn — edge count can dip below "
                         "n*k/2 at high beta, unlike networkx's "
                         "redraw-until-clean Watts-Strogatz")
+    p.add_argument("--edge-chunks", type=int, default=1,
+                   help="fanout-all delivery in K sequential edge slices "
+                        "(K-fold smaller per-edge intermediates; the cure "
+                        "for the 100M-node diffusion memory wall)")
     p.add_argument("--metrics-out", type=str, default=None,
                    help="JSONL file for per-chunk metrics records")
     p.add_argument("--checkpoint-dir", type=str, default=None)

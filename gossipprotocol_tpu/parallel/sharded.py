@@ -116,6 +116,7 @@ def _sharded_core(
             all_sum=all_sum,
             all_alive=all_alive,
             targets_alive=targets_alive,
+            edge_chunks=cfg.edge_chunks,
         )
     if cfg.delivery == "invert":
         raise ValueError(

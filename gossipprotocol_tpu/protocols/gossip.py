@@ -237,11 +237,15 @@ def inverted_dense(topo: Topology):
         InvertedDense, dense_table,
     )
 
+    from gossipprotocol_tpu.protocols.sampling import chunked_put
+
     table, deg = dense_table(topo)
     rev, deg_nbr = reverse_slot_table(topo)
+    # chunked: at 100M nodes these tables are multi-GB and a single
+    # device_put transaction crashed the remote worker (VERDICT r3 #2)
     return InvertedDense(
-        table=jnp.asarray(table), degree=jnp.asarray(deg),
-        rev=jnp.asarray(rev), deg_nbr=jnp.asarray(deg_nbr),
+        table=chunked_put(table), degree=chunked_put(deg),
+        rev=chunked_put(rev), deg_nbr=chunked_put(deg_nbr),
     )
 
 

@@ -26,6 +26,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from gossipprotocol_tpu.topology.base import Topology
 
@@ -110,6 +111,25 @@ def use_dense(topo: Topology) -> bool:
     )
 
 
+def chunked_put(arr, max_bytes: int = 512 * 1024 * 1024):
+    """Host array -> device, split into <= max_bytes transfers.
+
+    A single multi-GB device_put through the remote (axon) tunnel can
+    exceed the worker watchdog's transaction budget — observed crashing
+    the 100M-node run when the ~3 GB inversion tables uploaded in one
+    piece (artifacts/gossip_100M.json r3 note). Row-sliced puts keep
+    every transaction bounded; one on-device concatenate reassembles
+    (transient 2x memory for the largest array).
+    """
+    a = np.asarray(arr)
+    if a.nbytes <= max_bytes:
+        return jnp.asarray(a)
+    row_bytes = max(int(a.itemsize) * int(np.prod(a.shape[1:], dtype=np.int64)), 1)
+    rows = max(1, max_bytes // row_bytes)
+    parts = [jax.device_put(a[i: i + rows]) for i in range(0, len(a), rows)]
+    return jnp.concatenate(parts, axis=0)
+
+
 def device_topology(topo: Topology, dense: Optional[bool] = None):
     """Topology → device arrays; None for the implicit complete graph.
 
@@ -123,12 +143,12 @@ def device_topology(topo: Topology, dense: Optional[bool] = None):
     if dense:
         table, deg = dense_table(topo)
         return DenseNeighbors(
-            table=jnp.asarray(table), degree=jnp.asarray(deg)
+            table=chunked_put(table), degree=chunked_put(deg)
         )
     return CSRNeighbors(
-        starts=jnp.asarray(topo.offsets[:-1]),
-        degree=jnp.asarray(topo.degree, dtype=jnp.int32),
-        indices=jnp.asarray(topo.indices, dtype=jnp.int32),
+        starts=chunked_put(topo.offsets[:-1]),
+        degree=chunked_put(topo.degree.astype(np.int32)),
+        indices=chunked_put(topo.indices.astype(np.int32)),
     )
 
 
