@@ -1,0 +1,93 @@
+"""100M-node gossip re-record with the inverted delivery ON (VERDICT r3 #2).
+
+Round 3's 100M run had to disable the engine's own gather-inverted
+delivery: the ~3 GB inversion tables uploaded in a single device_put
+transaction and the remote worker's watchdog killed it. Uploads now go
+through ``chunked_put`` (<= 512 MB slices), so this run compiles the
+full engine — scatter + inversion with the per-round on-device switch —
+and should sit near the engine's ~3.6x-faster saturated-phase delivery.
+
+Writes artifacts/gossip_100M.json (+ per-chunk JSONL) over round 3's
+all-scatter record.
+
+Usage: python experiments/gossip_100m.py [--nodes 100000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100_000_000)
+    ap.add_argument("--out", default="artifacts/gossip_100M.json")
+    args = ap.parse_args()
+
+    import jax
+
+    from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+
+    records = []
+    t0 = time.perf_counter()
+    topo = build_topology("imp3D", args.nodes, seed=0)
+    build_s = time.perf_counter() - t0
+    print(f"topology: {topo.num_nodes} nodes ({build_s:.0f}s)", flush=True)
+
+    jsonl = os.path.join(REPO, "artifacts", "gossip_100M.jsonl")
+    with open(jsonl, "w") as fh:
+        def cb(rec):
+            fh.write(json.dumps(rec) + "\n")
+            fh.flush()
+            print(rec, flush=True)
+
+        cfg = RunConfig(algorithm="gossip", seed=0, chunk_rounds=24,
+                        max_rounds=4096, metrics_callback=cb)
+        res = run_simulation(topo, cfg)
+
+    rec = {
+        "config": {
+            "nodes_requested": args.nodes,
+            "nodes_actual": topo.num_nodes,
+            "topology": "imp3D",
+            "algorithm": "gossip",
+            "seed": 0,
+            "chunk_rounds": 24,
+            "delivery": "engine default (scatter + gather-inversion, "
+                        "on-device per-round switch)",
+        },
+        "rounds": int(res.rounds),
+        "converged": bool(res.converged),
+        "wall_ms": round(res.wall_ms, 1),
+        "ms_per_round": round(res.wall_ms / max(res.rounds, 1), 1),
+        "compile_ms": round(res.compile_ms, 1),
+        "topology_build_s": round(build_s, 1),
+        "backend": "tpu (v5e single chip)",
+        "notes": [
+            "10,000x the reference's demonstrated 9k-node ceiling, on "
+            "ONE chip",
+            "re-recorded with the inverted delivery ENABLED: the round-3 "
+            "blocker (one ~3 GB device_put of the inversion tables "
+            "tripping the remote watchdog) is gone — chunked_put splits "
+            "every upload into <=512 MB transactions",
+            "round-3 all-scatter baseline: 77 rounds / 94.3 s "
+            "(~1.2 s/round)",
+            "per-chunk records in gossip_100M.jsonl",
+        ],
+    }
+    with open(os.path.join(REPO, args.out), "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps(rec), flush=True)
+    assert res.converged
+
+
+if __name__ == "__main__":
+    main()

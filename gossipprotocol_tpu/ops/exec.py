@@ -41,8 +41,9 @@ class DeviceStage(NamedTuple):
 class DeviceFinal(NamedTuple):
     k: int
     idx: jax.Array   # int8 [nt_out, k, 3, 128, 128]
-    mask: jax.Array  # uint8 [nt_out, k, 128, 16] — bitpacked source-k
-                     # selector (bit j of byte j//8; 8x smaller args)
+    mask: jax.Array  # uint8 [nt_out, k, 16, 128] — bitpacked source-k
+                     # selector stored transposed (bit j of byte j//8;
+                     # 8x smaller args, minor dim 128 so no tile pad)
 
 
 class DevicePlan(NamedTuple):
@@ -67,14 +68,16 @@ def device_plan(plan: RoutePlan) -> DevicePlan:
     def shrink(idx):
         # unit=2: odd entries are derivable (see _widen_pair_idx). The
         # lane-stage arrays (components 0, 2) halve along lanes; the
-        # row-stage array (component 1) has its redundancy along rows,
-        # so it is transposed into the same [128, 64] shape.
+        # row-stage array (component 1) has its redundancy along rows.
+        # All three are stored TRANSPOSED [64, 128] so the minor dim
+        # stays 128 (an int8 [., 64] minor dim pads back to 128 under
+        # the (32, 128) tile — measured 5.9 GB of padding at 10M).
         if plan.unit != 2:
             return idx
-        out = np.empty(idx.shape[:-2] + (128, 64), idx.dtype)
-        out[..., 0, :, :] = idx[..., 0, :, 0::2]
-        out[..., 2, :, :] = idx[..., 2, :, 0::2]
-        out[..., 1, :, :] = np.swapaxes(idx[..., 1, :, :], -1, -2)[..., 0::2]
+        out = np.empty(idx.shape[:-2] + (64, 128), idx.dtype)
+        out[..., 0, :, :] = np.swapaxes(idx[..., 0, :, 0::2], -1, -2)
+        out[..., 2, :, :] = np.swapaxes(idx[..., 2, :, 0::2], -1, -2)
+        out[..., 1, :, :] = np.swapaxes(idx[..., 1, :, :], -1, -2)[..., 0::2].swapaxes(-1, -2)
         return out
 
     stages = tuple(
@@ -86,18 +89,22 @@ def device_plan(plan: RoutePlan) -> DevicePlan:
     packed = np.zeros(m.shape[:-1], np.uint8)
     for b in range(8):
         packed |= (m[..., b] << b).astype(np.uint8)
+    packed = np.swapaxes(packed, -1, -2)  # minor dim 128: no tile padding
     fin = DeviceFinal(plan.final.k, jnp.asarray(shrink(plan.final.idx)),
                       jnp.asarray(packed))
     return DevicePlan(plan.unit, plan.nt_in, plan.nt_out, stages, fin)
 
 
-def _widen_pair_idx(half, add_parity):
-    """[128, 64] int8 -> [128, 128] int32 lane indices (unit=2 plans).
+def _widen_pair_idx(half_t, add_parity):
+    """[64, 128] int8 (stored transposed) -> [128, 128] int32 indices.
 
     Pair-aligned gathers touch lanes (2q, 2q+1) together, so only the
-    even-lane entry is stored (half idx args, ~4 GB at 10M). Lane c
-    reads half[c // 2] (+ c % 2 for the lane-stage indices).
+    even-lane entry is stored — and stored TRANSPOSED so the minor dim
+    stays 128: an int8 [., 64] minor dim tiles to (32, 128) on TPU,
+    padding right back to full width (measured 5.9 GB of layout padding
+    at 10M). Lane c reads half[c // 2] (+ c % 2 for lane-stage indices).
     """
+    half = half_t.T                      # [128, 64]
     col = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
     wide = jnp.concatenate(
         [half, jnp.zeros((128, 64), jnp.int8)], axis=1).astype(jnp.int32)
@@ -109,7 +116,8 @@ def _route_one(x, i1, i2, i3, unit):
     if unit == 2:
         i1 = _widen_pair_idx(i1, True)
         # i2's redundancy is along ROWS (both f32 columns of a pair
-        # carry one row move), so it is stored transposed: undo here
+        # carry one row move); combined with transposed storage its
+        # reconstruction is the widen WITHOUT the final .T
         i2 = _widen_pair_idx(i2, False).T
         i3 = _widen_pair_idx(i3, True)
     else:
@@ -143,7 +151,7 @@ def _stage_call(st: DeviceStage, cur: jax.Array, interpret: bool,
         out_shape=out_shape,
         in_specs=[
             pl.BlockSpec((1, 128, 128), lambda p, i: (p * tau + i, 0, 0)),
-            pl.BlockSpec((1, o_count, 3, 128, iw),
+            pl.BlockSpec((1, o_count, 3) + st.idx.shape[-2:],
                          lambda p, i: (p * tau + i, 0, 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, st.b, 1, st.cr, 128),
@@ -168,7 +176,7 @@ def _final_call(fin: DeviceFinal, nt_out: int, cur: jax.Array,
             # unpack bit (col % 8) of packed byte (col // 8): a
             # duplicating lane gather widens [128,16] -> [128,128]
             bytes_ = jnp.take_along_axis(
-                jnp.concatenate([m_ref[0, kk],
+                jnp.concatenate([m_ref[0, kk].T,
                                  jnp.zeros((128, 112), jnp.uint8)], 1)
                 .astype(jnp.int32),
                 col // 8, axis=1)
@@ -182,8 +190,9 @@ def _final_call(fin: DeviceFinal, nt_out: int, cur: jax.Array,
         out_shape=jax.ShapeDtypeStruct((nt_out, 128, 128), cur.dtype),
         in_specs=[
             pl.BlockSpec((1, k, 128, 128), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1, k, 3, 128, iw), lambda i: (i, 0, 0, 0, 0)),
-            pl.BlockSpec((1, k, 128, 16), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, k, 3) + fin.idx.shape[-2:],
+                         lambda i: (i, 0, 0, 0, 0)),
+            pl.BlockSpec((1, k, 16, 128), lambda i: (i, 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 128, 128), lambda i: (i, 0, 0)),
         interpret=interpret,
