@@ -1,0 +1,100 @@
+"""Cross the 100M push-sum memory wall (VERDICT r3 #3).
+
+Round 3 mapped two walls at 100M nodes: f32 single-target cannot certify
+(w underflows in receipt dry spells), and fanout-all diffusion — the
+variant that certifies — needed 18.07 GB of per-edge intermediates vs
+15.75 GB of HBM. The cure shipped this round is ``--edge-chunks K``:
+delivery in K sequential edge slices, K-fold smaller intermediates.
+This script runs 100M-node Erdős–Rényi fanout-all diffusion, f32,
+edge-chunked, under the sound global predicate, recording per-chunk
+error so the artifact shows the wall CROSSED (the config compiles,
+fits, executes, and the certified error descends) and — budget
+permitting — certified.
+
+Usage: python experiments/pushsum_100m.py [--max-rounds 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100_000_000)
+    ap.add_argument("--avg-degree", type=float, default=8.0)
+    ap.add_argument("--edge-chunks", type=int, default=6)
+    ap.add_argument("--max-rounds", type=int, default=16)
+    ap.add_argument("--tol", type=float, default=1e-4)
+    ap.add_argument("--out", default="artifacts/pushsum_100M_diffusion.json")
+    args = ap.parse_args()
+
+    from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+
+    t0 = time.perf_counter()
+    topo = build_topology("erdos_renyi", args.nodes, seed=0,
+                          avg_degree=args.avg_degree)
+    build_s = time.perf_counter() - t0
+    print(f"topology: {topo.num_nodes} nodes, "
+          f"{topo.num_directed_edges} directed edges ({build_s:.0f}s)",
+          flush=True)
+
+    jsonl = os.path.join(REPO, "artifacts", "pushsum_100M_diffusion.jsonl")
+    with open(jsonl, "w") as fh:
+        def cb(rec):
+            fh.write(json.dumps(rec) + "\n")
+            fh.flush()
+            print(rec, flush=True)
+
+        cfg = RunConfig(
+            algorithm="push-sum", fanout="all", predicate="global",
+            tol=args.tol, seed=0, edge_chunks=args.edge_chunks,
+            chunk_rounds=1, max_rounds=args.max_rounds,
+            metrics_callback=cb,
+        )
+        res = run_simulation(topo, cfg)
+
+    rec = {
+        "config": {
+            "nodes": topo.num_nodes,
+            "topology": f"erdos_renyi(avg_degree={args.avg_degree})",
+            "directed_edges": topo.num_directed_edges,
+            "algorithm": "push-sum fanout-all diffusion",
+            "dtype": "float32",
+            "predicate": f"global tol={args.tol}",
+            "edge_chunks": args.edge_chunks,
+            "round_budget": args.max_rounds,
+        },
+        "rounds": int(res.rounds),
+        "converged": bool(res.converged),
+        "estimate_error_final": float(res.estimate_error)
+        if res.estimate_error is not None else None,
+        "wall_ms": round(res.wall_ms, 1),
+        "ms_per_round": round(res.wall_ms / max(res.rounds, 1), 1),
+        "compile_ms": round(res.compile_ms, 1),
+        "topology_build_s": round(build_s, 1),
+        "backend": "tpu (v5e single chip)",
+        "notes": [
+            "VERDICT r3 #3: round 3 measured this config's per-edge "
+            "intermediates at 18.07 GB vs 15.75 GB HBM — it could not "
+            "compile. --edge-chunks slices the delivery; this run "
+            "compiles, fits, and executes at 100M on one chip.",
+            "per-round records (converged counts, error trajectory) in "
+            "pushsum_100M_diffusion.jsonl",
+        ],
+    }
+    with open(os.path.join(REPO, args.out), "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()
