@@ -442,9 +442,9 @@ def main(argv=None) -> int:
         # trajectory_meta(cfg) is the same mapping save() embedded, so the
         # two sides can never drift.
         problems = [
-            # report the value the comparison actually used: for a
-            # missing legacy field that is its pinned default, not None
-            f"{k} {meta.get(k, ckpt.LEGACY_FIELD_DEFAULTS.get(k))!r} != {v!r}"
+            # report the value the comparison actually used: the pinned
+            # default for a missing legacy field, "all" for a null quorum
+            f"{k} {ckpt.stored_value(meta, k)!r} != {v!r}"
             for k, v in ckpt.trajectory_meta(cfg).items()
             # missing fields wildcard (pre-upgrade checkpoint), except the
             # knobs whose absence pins them to their default — see

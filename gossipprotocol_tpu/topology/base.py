@@ -140,9 +140,13 @@ class Topology:
             assert self.indices.min() >= 0 and self.indices.max() < n, (
                 "neighbor index out of range"
             )
-        # no self-loops
-        row = np.repeat(np.arange(n, dtype=np.int64), np.diff(self.offsets))
-        assert not (row == self.indices).any(), "self-loop present"
+        # no self-loops — except for asymmetric (reference-quirk) builds,
+        # where build_imp3d_reference_quirks deliberately emits them (the
+        # reference's extra-neighbor draw can land on self, Program.fs:260):
+        # --check must stay usable on a topology the same CLI builds and runs
+        if not self.asymmetric:
+            row = np.repeat(np.arange(n, dtype=np.int64), np.diff(self.offsets))
+            assert not (row == self.indices).any(), "self-loop present"
 
 
 def csr_from_edges(num_nodes: int, edges: np.ndarray, kind: str) -> Topology:

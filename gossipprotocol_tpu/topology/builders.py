@@ -175,6 +175,9 @@ def add_isolated_rows(topo: Topology, count: int = 1) -> Topology:
     if base_mask is None:
         base_mask = np.ones(topo.num_nodes, bool)
     mask = np.concatenate([base_mask, np.zeros(count, bool)])
+    # birth_alive() freezes cached masks because the cache hands the same
+    # array to every caller; a seeded cache must honor the same contract
+    mask.setflags(write=False)
     object.__setattr__(out, "_birth_alive_cache", mask)
     return out
 

@@ -36,14 +36,27 @@ TRAJECTORY_FIELDS = (
     # different protocol; delivery="invert" sums received mass in a
     # different float order than the scatter (both docstrings say so)
     "fanout", "delivery",
+    # per-chunk partial sums change the delivery's float accumulation
+    # order, exactly like delivery="invert" — a resume under a different
+    # chunking silently continues a different-accumulation-order trajectory
+    "edge_chunks",
 )
 
 
 # Fields a pre-upgrade checkpoint lacks but whose value is nevertheless
 # known: the knob did not exist when the checkpoint was written, so the run
 # necessarily used the default. Distinct from genuinely-unknowable absent
-# fields (pre-upgrade eps/tol...), which resume validation must wildcard.
+# fields which resume validation must wildcard — pre-upgrade eps/tol, and
+# edge_chunks, whose CLI knob predates its trajectory-field status: a
+# missing-key checkpoint may have run with ANY chunking, so pinning it
+# would falsely reject the matching resume and silently accept chunking=1.
 LEGACY_FIELD_DEFAULTS = {"fanout": "one", "delivery": "scatter"}
+
+# Sentinel written for alert_quorum=None (the all-nodes stop rule). None
+# cannot be stored raw: resume validation could not tell "all-nodes run"
+# from "field absent, value unknowable", and splicing a quorum run onto an
+# all-nodes run must be an error (see field_matches).
+_QUORUM_ALL = "all"
 
 
 def field_matches(meta: dict, field: str, value) -> bool:
@@ -54,11 +67,39 @@ def field_matches(meta: dict, field: str, value) -> bool:
     "the default": resuming an old single-target/scatter checkpoint under
     ``--fanout all`` or ``--delivery invert`` must be a mismatch, not a
     silent splice of two different trajectories.
+
+    ``alert_quorum`` is special: ``None`` is a *real value* there (the
+    all-nodes stop rule), so a stored null — written by checkpoints that
+    predate the :data:`_QUORUM_ALL` sentinel — means "all nodes", not
+    "unknowable"; only a checkpoint whose metadata lacks the key entirely
+    wildcards.
     """
+    stored = stored_value(meta, field)
+    if stored is None:
+        return True
+    if field == "alert_quorum" and value is None:
+        value = _QUORUM_ALL
+    return stored == value
+
+
+def stored_value(meta: dict, field: str):
+    """The normalized stored value resume validation compares against,
+    or ``None`` when the field wildcards (genuinely unknowable).
+
+    Shared by :func:`field_matches` and the CLI's mismatch message so the
+    reported value is always the one the comparison used — a raw ``meta``
+    read would print ``None`` for a legacy pinned default or for
+    alert_quorum's null encoding, both of which read as
+    "unknowable/wildcard" to a user who just learned the wildcarding rules.
+    """
+    if field == "alert_quorum":
+        if field not in meta:
+            return None  # pre-quorum checkpoint
+        return _QUORUM_ALL if meta[field] is None else meta[field]
     stored = meta.get(field)
     if stored is None:
         stored = LEGACY_FIELD_DEFAULTS.get(field)
-    return stored is None or stored == value
+    return stored
 
 
 def trajectory_meta(cfg) -> dict:
@@ -69,6 +110,8 @@ def trajectory_meta(cfg) -> dict:
     resuming run's config — no hand-duplicated field mapping to drift.
     """
     meta = {f: getattr(cfg, f, None) for f in TRAJECTORY_FIELDS}
+    if meta["alert_quorum"] is None:
+        meta["alert_quorum"] = _QUORUM_ALL
     if meta.get("dtype") is not None:
         # jnp.float32 the class is not JSON-able; its dtype name is
         meta["dtype"] = np.dtype(meta["dtype"]).name

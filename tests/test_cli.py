@@ -270,6 +270,73 @@ def test_resume_pins_legacy_defaults_for_fanout_and_delivery(tmp_path, capsys):
     assert code == 0
 
 
+def test_resume_rejects_edge_chunks_mismatch(tmp_path, capsys):
+    """edge_chunks changes the delivery's float accumulation order (per-chunk
+    partial sums), exactly like --delivery invert — resuming under a
+    different chunking must be rejected. (A checkpoint lacking the key
+    wildcards, NOT pins: the --edge-chunks knob predates its
+    trajectory-field status, so the missing value is genuinely unknowable.)"""
+    from gossipprotocol_tpu.utils.checkpoint import field_matches
+
+    assert field_matches({}, "edge_chunks", 8)
+    assert not field_matches({"edge_chunks": 2}, "edge_chunks", 3)
+    ckdir = str(tmp_path / "ck")
+    code, _, _ = run_cli([
+        "64", "imp3D", "push-sum", "--fanout", "all", "--edge-chunks", "2",
+        "--checkpoint-dir", ckdir, "--checkpoint-every", "1",
+        "--chunk-rounds", "4", "--max-rounds", "8", "--quiet",
+    ], capsys)
+    assert code == 1  # round budget hit, checkpoint written
+    code, _, err = run_cli([
+        "64", "imp3D", "push-sum", "--fanout", "all", "--edge-chunks", "3",
+        "--resume", ckdir, "--quiet",
+    ], capsys)
+    assert code == 2 and "edge_chunks" in err
+    # matching chunking resumes fine (code 1 = further round budget hit —
+    # 64-node diffusion sits on the f32 ratio floor and never fires the
+    # 1e-10 streak; accepted-and-advanced is what this asserts, not
+    # convergence)
+    code, out, _ = run_cli([
+        "64", "imp3D", "push-sum", "--fanout", "all", "--edge-chunks", "2",
+        "--resume", ckdir, "--max-rounds", "16",
+    ], capsys)
+    assert code != 2
+    assert re.search(r"rounds: 16", out)
+
+
+def test_quorum_field_validation_directions():
+    """alert_quorum=None is a real value (the all-nodes stop rule), not an
+    unknowable: a stored null — or the 'all' sentinel newer checkpoints
+    write — must mismatch a quorum resume and vice versa. Only a
+    checkpoint predating the field wildcards."""
+    from gossipprotocol_tpu.utils.checkpoint import field_matches
+
+    # stored all-nodes (either encoding) vs quorum resume: mismatch
+    assert not field_matches({"alert_quorum": None}, "alert_quorum", 39)
+    assert not field_matches({"alert_quorum": "all"}, "alert_quorum", 39)
+    # stored quorum vs all-nodes resume: mismatch (the direction that
+    # already worked)
+    assert not field_matches({"alert_quorum": 39}, "alert_quorum", None)
+    # matching values, both encodings
+    assert field_matches({"alert_quorum": None}, "alert_quorum", None)
+    assert field_matches({"alert_quorum": "all"}, "alert_quorum", None)
+    assert field_matches({"alert_quorum": 39}, "alert_quorum", 39)
+    # field absent entirely: pre-quorum checkpoint, genuinely unknowable
+    assert field_matches({}, "alert_quorum", 39)
+
+
+def test_check_flag_accepts_reference_mode_imp3d(capsys):
+    """--check --semantics reference on imp3D: the quirk builder emits
+    deliberate self-loops (the reference's extra-neighbor draw can land on
+    self), and --check must not call invalid a topology the same CLI
+    builds and runs."""
+    code, _, err = run_cli([
+        "27", "imp3D", "gossip", "--semantics", "reference", "--seed", "1",
+        "--check", "--chunk-rounds", "64", "--quiet",
+    ], capsys)
+    assert code == 0, err
+
+
 def test_resume_argv_rewrite():
     """Pure recovery-argv helper: strips prior --resume/--auto-resume in
     both '--flag value' and '--flag=value' spellings, pins the new ones."""

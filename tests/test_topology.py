@@ -141,6 +141,12 @@ def test_birth_alive_cached_and_component_aware():
     a1 = t.birth_alive()
     assert list(a1) == [True, True, True, True, False, False]
     assert t.birth_alive() is a1  # cached, not recomputed
+    # the cache hands the same array to every caller — it must be frozen
+    # both when computed here and when seeded by add_isolated_rows
+    assert not a1.flags.writeable
+    from gossipprotocol_tpu.topology.builders import add_isolated_rows
+
+    assert not add_isolated_rows(t).birth_alive().flags.writeable
 
 
 # --- small_world (Watts–Strogatz; beyond-reference family) ----------------
