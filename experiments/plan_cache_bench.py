@@ -1,0 +1,98 @@
+"""Routed-plan cache: cold build vs cached load at scale (VERDICT r4 #2).
+
+Round 4 measured the 10M routed-delivery plan build at 2 240 s of
+single-core host work — 5x the entire 71-round scatter run it replaces —
+making the 21.2x routed kernel (artifacts/routed_diffusion_10m.json) a
+benchmark fact, not a usable capability. This script measures the two
+fixes landed in round 5 on the same 10M power-law topology:
+
+  1. the fused native tile router (native/routecolor.cpp
+     route_tiles_full: bijection completion + Euler coloring + index
+     assembly in one C++ pass) cutting the cold build itself, and
+  2. the content-addressed disk cache (ops/plancache.py) that turns
+     every repeat run into an npz load.
+
+Usage: python experiments/plan_cache_bench.py [--nodes 10000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=10_000_000)
+    ap.add_argument("--m", type=int, default=4)
+    ap.add_argument("--out", default="artifacts/routed_plan_cache_10m.json")
+    args = ap.parse_args()
+
+    from gossipprotocol_tpu import build_topology
+    from gossipprotocol_tpu.ops import plancache
+
+    t0 = time.perf_counter()
+    topo = build_topology("powerlaw", args.nodes, seed=7, m=args.m)
+    topo_s = time.perf_counter() - t0
+    print(f"topology: {topo.num_directed_edges} directed edges "
+          f"({topo_s:.0f}s)", flush=True)
+
+    cache_dir = plancache.default_cache_dir()
+    path = plancache.entry_path(cache_dir, plancache.cache_key(topo))
+    if os.path.exists(path):
+        os.unlink(path)  # measure a genuinely cold build
+
+    t0 = time.perf_counter()
+    rd, state = plancache.routed_delivery_cached(
+        topo, cache_dir=cache_dir, device=False,
+        progress=lambda m: print(m, flush=True))
+    cold_s = time.perf_counter() - t0
+    assert state == "miss"
+    entry_mb = os.path.getsize(path) / 1e6
+    print(f"cold build+save: {cold_s:.1f}s, entry {entry_mb:.0f} MB",
+          flush=True)
+
+    del rd
+    t0 = time.perf_counter()
+    rd2, state2 = plancache.routed_delivery_cached(
+        topo, cache_dir=cache_dir, device=False)
+    warm_s = time.perf_counter() - t0
+    assert state2 == "hit"
+    print(f"cached load: {warm_s:.1f}s", flush=True)
+
+    rec = {
+        "nodes": topo.num_nodes,
+        "topology": f"powerlaw (BA m={args.m})",
+        "edges_directed": int(topo.num_directed_edges),
+        "build_s_round4": 2240.5,
+        "build_s_cold": round(cold_s, 1),
+        "load_s_cached": round(warm_s, 1),
+        "cache_entry_mb": round(entry_mb, 1),
+        "speedup_repeat_runs": round(2240.5 / warm_s, 1),
+        "host": "1-core VM (the round-4 number's own host)",
+        "notes": [
+            "cold path includes writing the cache entry; cached path is "
+            "the full npz load + RoutedDelivery reassembly (host side; "
+            "the one-time device upload is shared by both paths and "
+            "excluded, as in round 4's build_s)",
+            "cache key: blake2b adjacency content hash "
+            "(plancache.cache_key) + plancache.FORMAT_VERSION",
+            "cold build improvement over round 4 comes from "
+            "native/routecolor.cpp route_tiles_full (fused completion + "
+            "coloring + index assembly)",
+        ],
+    }
+    with open(os.path.join(REPO, args.out), "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,9 @@ import jax.numpy as jnp
 
 from gossipprotocol_tpu.ops.plan import build_route_plan
 from gossipprotocol_tpu.ops.exec import device_plan, apply_plan
+# registers the DevicePlan pytree (geometry static, tables leaves) —
+# without it tree.map would asarray the geometry ints too
+import gossipprotocol_tpu.ops.delivery  # noqa: F401
 
 
 def sync(x):
@@ -48,7 +51,10 @@ def main():
     t_plan = time.perf_counter() - t0
     print(f"plan: stages={len(plan.stages)} K={plan.final.k} "
           f"built in {t_plan:.1f}s (+{t_perm:.1f}s perm)", flush=True)
-    dp = device_plan(plan)
+    # device_plan now returns host tables; upload explicitly — closing
+    # the jit below over numpy leaves would embed them as jaxpr
+    # constants (the "never close jit over GB tables" pitfall)
+    dp = jax.tree.map(jnp.asarray, device_plan(plan))
 
     nt = plan.nt_in
     x = jnp.asarray(rng.standard_normal(nt * 16384), jnp.float32)

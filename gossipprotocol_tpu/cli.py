@@ -51,6 +51,7 @@ def _build_config(args, algo, fault_plan, jnp, alert_quorum=None):
         fanout=args.fanout,
         edge_chunks=args.edge_chunks,
         delivery=args.delivery,
+        plan_cache=args.plan_cache,
         value_mode=args.value_mode,
         max_rounds=args.max_rounds,
         chunk_rounds=args.chunk_rounds,
@@ -216,6 +217,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "component-closed dead sets; trajectories agree "
                         "with scatter to float accumulation order; "
                         "measured ~7x faster at 10M power-law)")
+    p.add_argument("--plan-cache", type=str, default=None, metavar="DIR",
+                   help="routed-delivery plan cache directory (default "
+                        "$GOSSIP_TPU_PLAN_CACHE or "
+                        "~/.cache/gossipprotocol_tpu/routed-plans; 'none' "
+                        "disables). Plans are keyed by the adjacency "
+                        "fingerprint; a hit loads bitwise the tables a "
+                        "build would produce, skipping the O(E) "
+                        "single-core compile (~37 min at 10M nodes)")
     p.add_argument("--value-mode", choices=["scaled", "index"], default="scaled",
                    help="push-sum init: i/N (TPU-safe) or the reference's s_i=i")
     p.add_argument("--x64", action="store_true",

@@ -88,6 +88,12 @@ class RunConfig:
                                    # SLOWER on TPU v5e — kept as a
                                    # validated negative result, see
                                    # README + pushsum.received_by_inversion)
+    plan_cache: Optional[str] = None  # routed-delivery plan cache dir;
+                                   # None = default ($GOSSIP_TPU_PLAN_CACHE
+                                   # or ~/.cache/...), "none" = disabled.
+                                   # NOT a trajectory field: a cache hit
+                                   # loads bitwise the tables the build
+                                   # produces (tests/test_routing.py)
     value_mode: str = "scaled"     # push-sum init: "scaled" (i/N) | "index" (i)
     dtype: Any = jnp.float32
     max_rounds: int = 1_000_000
@@ -521,9 +527,10 @@ def device_arrays(topo: Topology, cfg: RunConfig):
     fanout-all diffusion (which draws nothing and walks every edge)."""
     if cfg.algorithm == "push-sum" and cfg.fanout == "all":
         if cfg.delivery == "routed":
-            from gossipprotocol_tpu.ops.delivery import build_routed_delivery
+            from gossipprotocol_tpu.ops.plancache import routed_delivery_cached
 
-            return build_routed_delivery(topo)
+            rd, _ = routed_delivery_cached(topo, cache_dir=cfg.plan_cache)
+            return rd
         from gossipprotocol_tpu.protocols.diffusion import diffusion_edges
 
         return diffusion_edges(topo)

@@ -91,6 +91,14 @@ def _configure_routecolor(lib: ctypes.CDLL) -> None:
     lib.route_color_tiles.argtypes = [
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, _I32P, _I32P, _I32P,
     ]
+    # fused tile router is absent from pre-round-5 builds of the .so;
+    # callers probe hasattr and fall back to the numpy pipeline
+    if hasattr(lib, "route_tiles_full"):
+        lib.route_tiles_full.restype = ctypes.c_int64
+        lib.route_tiles_full.argtypes = [
+            ctypes.c_int64, ctypes.c_int32, _I64P,
+            np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+        ]
 
 
 def _load_routecolor() -> Optional[ctypes.CDLL]:
@@ -132,6 +140,34 @@ def route_color_tiles(
     if rc != 0:
         raise ValueError(f"route_color_tiles: malformed input (rc={rc})")
     return color
+
+
+def route_tiles_full(perms: np.ndarray, unit: int) -> Optional[np.ndarray]:
+    """Fused native tile router (see ``native/routecolor.cpp``).
+
+    ``perms``: int64 ``[T, U]`` per-tile unit permutations, ``-1`` slots
+    allowed (completed to bijections internally with the same fill rule
+    as ``ops.plan._complete_bijections``). Returns the stacked gather
+    triples int8 ``[T, 3, 128, 128]`` in ``ops.clos.route_tile_perms``'s
+    convention, or None when the library (or this entry point) is
+    unavailable.
+    """
+    lib = _load_routecolor()
+    if lib is None or not hasattr(lib, "route_tiles_full"):
+        return None
+    perms = np.ascontiguousarray(perms, dtype=np.int64)
+    # the C side derives U from unit alone and strides the buffer by it —
+    # a mismatched width would read out of bounds, not raise
+    if perms.ndim != 2 or perms.shape[1] != 16384 // unit:
+        raise ValueError(
+            f"route_tiles_full: perms must be [T, {16384 // unit}] for "
+            f"unit={unit}, got {perms.shape}")
+    t = perms.shape[0]
+    idx = np.empty((t, 3, 128, 128), np.int8)
+    rc = lib.route_tiles_full(t, unit, perms.reshape(-1), idx.reshape(-1))
+    if rc != 0:
+        raise ValueError(f"route_tiles_full: non-injective perm (rc={rc})")
+    return idx
 
 
 def _topo_csr64(topo):

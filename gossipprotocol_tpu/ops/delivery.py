@@ -185,8 +185,26 @@ class RoutedDelivery(NamedTuple):  # registered below: geometry static
 _register_delivery()
 
 
-def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
+def to_device(rd: RoutedDelivery) -> RoutedDelivery:
+    """One-time upload of a host-built (or cache-loaded) delivery.
+
+    RoutedDelivery is a registered pytree whose leaves are the routing
+    tables; geometry rides in aux_data. Uploads go through
+    ``chunked_put`` so no single transaction exceeds the remote-tunnel
+    watchdog's budget (the realmask alone is ~1.3 GB at 10M nodes).
+    """
+    from gossipprotocol_tpu.protocols.sampling import chunked_put
+
+    return jax.tree.map(chunked_put, rd)
+
+
+def build_routed_delivery(topo: Topology, progress=None,
+                          device: bool = True) -> RoutedDelivery:
     """Compile the three routing plans for a topology (host, one-time).
+
+    ``device=False`` keeps every table a host numpy array — the form the
+    plan cache (:mod:`gossipprotocol_tpu.ops.plancache`) serializes;
+    ``device=True`` finishes with :func:`to_device`.
 
     Cites the capability source: the reference's push-sum send
     (``Program.fs:128``) — here generalized to the fanout-all diffusion
@@ -330,14 +348,15 @@ def build_routed_delivery(topo: Topology, progress=None) -> RoutedDelivery:
     plans_out = _chained_plans(src_out, m_in=2 * nu, progress=progress,
                                unit=1)
 
-    return RoutedDelivery(
+    rd = RoutedDelivery(
         n=n, nu=nu, m_pairs=m_pairs, classes=classes,
         plan_in=tuple(device_plan(p) for p in plans_in),
         plan_m=tuple(device_plan(p) for p in plans_m),
         plan_out=tuple(device_plan(p) for p in plans_out),
-        realmask=jnp.asarray(realmask),
-        degree=jnp.asarray(degree, jnp.int32),
+        realmask=realmask,
+        degree=np.asarray(degree, np.int32),
     )
+    return to_device(rd) if device else rd
 
 
 def _check_geometry(name: str, p) -> None:

@@ -65,6 +65,13 @@ class DevicePlan(NamedTuple):
 
 
 def device_plan(plan: RoutePlan) -> DevicePlan:
+    """Compact a RoutePlan's tables into their device storage format.
+
+    The arrays stay HOST numpy: DevicePlan is a registered pytree, so the
+    one-time upload is a ``jax.tree.map`` over its leaves (see
+    ``delivery.to_device``) — keeping this function pure host work is
+    what lets the plan cache serialize exactly what the device consumes.
+    """
     def shrink(idx):
         # unit=2: odd entries are derivable (see _widen_pair_idx). The
         # lane-stage arrays (components 0, 2) halve along lanes; the
@@ -82,7 +89,7 @@ def device_plan(plan: RoutePlan) -> DevicePlan:
 
     stages = tuple(
         DeviceStage(st.p, st.tau_in, st.b, st.cr, st.o, st.tau_slab,
-                    jnp.asarray(shrink(st.idx)))
+                    shrink(st.idx))
         for st in plan.stages)
     m = np.asarray(plan.final.mask, np.uint8).reshape(
         plan.nt_out, plan.final.k, 128, 16, 8)
@@ -90,8 +97,7 @@ def device_plan(plan: RoutePlan) -> DevicePlan:
     for b in range(8):
         packed |= (m[..., b] << b).astype(np.uint8)
     packed = np.swapaxes(packed, -1, -2)  # minor dim 128: no tile padding
-    fin = DeviceFinal(plan.final.k, jnp.asarray(shrink(plan.final.idx)),
-                      jnp.asarray(packed))
+    fin = DeviceFinal(plan.final.k, shrink(plan.final.idx), packed)
     return DevicePlan(plan.unit, plan.nt_in, plan.nt_out, stages, fin)
 
 

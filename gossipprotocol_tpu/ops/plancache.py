@@ -1,0 +1,235 @@
+"""Disk cache for routed-delivery plans (SURVEY.md §5.4/§5.6 applied to
+the routing compiler).
+
+The plan build is O(E) single-core host work — measured 2 240 s at 10M
+power-law nodes on this 1-CPU rig (artifacts/routed_diffusion_10m.json)
+— while the tables it produces are pure content-addressed functions of
+the adjacency. Caching them keyed by
+:func:`gossipprotocol_tpu.utils.checkpoint.topology_fingerprint` turns
+every repeat ``--delivery routed`` run from a ~37-minute stall into a
+few seconds of npz load, which is what converts the measured 21.2×
+kernel win (``Program.fs:128``'s delivery at scale) from a benchmark
+fact into a usable capability.
+
+Format: one uncompressed ``.npz`` per topology (tables are near-random
+int8 — zlib would buy little at single-core cost; the realmask, the one
+highly compressible array, is bit-packed instead: 8× smaller than its
+f32 device form). Writes publish via ``os.replace`` so a crashed build
+never leaves a truncated cache entry behind.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import zipfile
+from typing import Optional
+
+import numpy as np
+
+from gossipprotocol_tpu.ops.delivery import RoutedDelivery, to_device
+from gossipprotocol_tpu.ops.exec import DeviceFinal, DevicePlan, DeviceStage
+
+# Bump whenever the on-device table layout changes (shrink/transpose/
+# bitpack conventions in ops/exec.py or the RoutedDelivery fields): a
+# stale-format entry must rebuild, not deserialize garbage.
+FORMAT_VERSION = 1
+
+_PLAN_GROUPS = ("plan_in", "plan_m", "plan_out")
+
+
+def default_cache_dir() -> str:
+    """``$GOSSIP_TPU_PLAN_CACHE`` or ``~/.cache/gossipprotocol_tpu/routed-plans``."""
+    env = os.environ.get("GOSSIP_TPU_PLAN_CACHE")
+    if env:
+        return env
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "gossipprotocol_tpu",
+        "routed-plans")
+
+
+def cache_key(topo) -> str:
+    """Content address of the adjacency for cache lookup.
+
+    NOT ``utils.checkpoint.topology_fingerprint``: that 32-bit crc was
+    designed for fail-closed resume *validation*, where a collision
+    merely rejects a valid resume. A cache key fails OPEN — a collision
+    would silently load another graph's routing tables — so it needs a
+    collision-resistant digest. blake2b streams at GB/s; even the 100M
+    CSR (~4 GB) keys in seconds against hours of build.
+    """
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(topo.num_nodes).encode())
+    h.update(np.ascontiguousarray(topo.offsets))
+    h.update(np.ascontiguousarray(topo.indices))
+    return f"{topo.num_nodes}-{h.hexdigest()}"
+
+
+def entry_path(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, f"routed_v{FORMAT_VERSION}_{key}.npz")
+
+
+def _pack_plan(prefix: str, dp: DevicePlan, arrays: dict) -> dict:
+    meta = {
+        "unit": dp.unit, "nt_in": dp.nt_in, "nt_out": dp.nt_out,
+        "stages": [[st.p, st.tau_in, st.b, st.cr, st.o, st.tau_slab]
+                   for st in dp.stages],
+        "final_k": dp.final.k,
+    }
+    for i, st in enumerate(dp.stages):
+        arrays[f"{prefix}.s{i}"] = np.asarray(st.idx)
+    arrays[f"{prefix}.fidx"] = np.asarray(dp.final.idx)
+    arrays[f"{prefix}.fmask"] = np.asarray(dp.final.mask)
+    return meta
+
+
+def _unpack_plan(prefix: str, meta: dict, z) -> DevicePlan:
+    stages = tuple(
+        DeviceStage(*geom, idx=z[f"{prefix}.s{i}"])
+        for i, geom in enumerate(meta["stages"]))
+    fin = DeviceFinal(meta["final_k"], z[f"{prefix}.fidx"],
+                      z[f"{prefix}.fmask"])
+    return DevicePlan(meta["unit"], meta["nt_in"], meta["nt_out"],
+                      stages, fin)
+
+
+def save(rd: RoutedDelivery, path: str) -> None:
+    """Serialize a HOST-side delivery (numpy leaves; ``device=False``)."""
+    arrays: dict = {}
+    meta = {
+        "format": FORMAT_VERSION,
+        "n": rd.n, "nu": rd.nu, "m_pairs": rd.m_pairs,
+        "classes": [list(c) for c in rd.classes],
+        "realmask_len": int(rd.realmask.shape[0]),
+    }
+    for group in _PLAN_GROUPS:
+        plans = getattr(rd, group)
+        meta[group] = [
+            _pack_plan(f"{group}{i}", dp, arrays)
+            for i, dp in enumerate(plans)
+        ]
+    arrays["realmask_bits"] = np.packbits(
+        np.asarray(rd.realmask).astype(bool))
+    arrays["degree"] = np.asarray(rd.degree, np.int32)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}.npz"
+    try:
+        np.savez(tmp, __meta__=json.dumps(meta), **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load(path: str) -> Optional[RoutedDelivery]:
+    """Host-side delivery from a cache entry, or None when absent/stale."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+            if meta.get("format") != FORMAT_VERSION:
+                return None
+            realmask = np.unpackbits(
+                z["realmask_bits"],
+                count=meta["realmask_len"]).astype(np.float32)
+            try:
+                os.utime(path)  # LRU signal for _evict_over_budget
+            except OSError:
+                pass
+            return RoutedDelivery(
+                n=meta["n"], nu=meta["nu"], m_pairs=meta["m_pairs"],
+                classes=tuple(tuple(c) for c in meta["classes"]),
+                plan_in=tuple(_unpack_plan(f"plan_in{i}", m, z)
+                              for i, m in enumerate(meta["plan_in"])),
+                plan_m=tuple(_unpack_plan(f"plan_m{i}", m, z)
+                             for i, m in enumerate(meta["plan_m"])),
+                plan_out=tuple(_unpack_plan(f"plan_out{i}", m, z)
+                               for i, m in enumerate(meta["plan_out"])),
+                realmask=realmask,
+                degree=z["degree"],
+            )
+    except (OSError, ValueError, KeyError, json.JSONDecodeError,
+            zipfile.BadZipFile):
+        # a corrupt entry (torn write, truncation, disk-full copy) must
+        # fall back to a rebuild, never crash the run — np.load raises
+        # BadZipFile for truncated zips, ValueError for non-zip bytes
+        return None
+
+
+def routed_delivery_cached(topo, cache_dir: Optional[str] = None,
+                           progress=None, device: bool = True):
+    """Cache-aware :func:`~gossipprotocol_tpu.ops.delivery.build_routed_delivery`.
+
+    ``cache_dir=None`` uses :func:`default_cache_dir`; the string
+    ``"none"`` disables caching entirely (build-only, nothing written).
+    Returns ``(delivery, cache_state)`` where cache_state is ``"hit"``,
+    ``"miss"`` (built and written), or ``"off"``.
+    """
+    from gossipprotocol_tpu.ops.delivery import build_routed_delivery
+
+    # resolve the env default BEFORE the "none" check: the env var
+    # documents "none" as its disable value too
+    cache_dir = cache_dir or default_cache_dir()
+    if cache_dir == "none" or topo.implicit_full:
+        # implicit full has no edge tables to cache (and the builder's
+        # rejection message is the right user-facing error for it)
+        return build_routed_delivery(topo, progress=progress,
+                                     device=device), "off"
+    path = entry_path(cache_dir, cache_key(topo))
+    rd = load(path)
+    if rd is not None:
+        if progress:
+            progress(f"routed delivery: plan cache hit ({path})")
+        return (to_device(rd) if device else rd), "hit"
+    rd = build_routed_delivery(topo, progress=progress, device=False)
+    try:
+        save(rd, path)
+        _evict_over_budget(cache_dir, keep=path)
+        if progress:
+            progress(f"routed delivery: plan cached ({path})")
+    except OSError as e:
+        # a full disk / read-only cache dir must not cost the user the
+        # build it just paid for — degrade to uncached, loudly
+        import warnings
+
+        warnings.warn(f"routed plan cache write failed ({e}); "
+                      "continuing uncached")
+    return (to_device(rd) if device else rd), "miss"
+
+
+def _evict_over_budget(cache_dir: str, keep: str) -> None:
+    """Drop oldest entries past ``$GOSSIP_TPU_PLAN_CACHE_GB`` (default 20).
+
+    Entries are GBs each at 10M+ nodes and the cache is default-on — a
+    seed sweep would otherwise fill the disk silently. Eviction is by
+    mtime (load() touches entries it hits, making this LRU-ish); the
+    just-written entry is always kept.
+    """
+    try:
+        budget = float(os.environ.get("GOSSIP_TPU_PLAN_CACHE_GB", "20"))
+    except ValueError:
+        budget = 20.0
+    try:
+        entries = [
+            (os.path.getmtime(p), os.path.getsize(p), p)
+            for f in os.listdir(cache_dir)
+            if f.startswith("routed_v") and f.endswith(".npz")
+            # ".tmp<pid>.npz" is a concurrent writer's in-flight entry:
+            # unlinking it would crash that writer's os.replace publish
+            and ".tmp" not in f
+            and (p := os.path.join(cache_dir, f)) != keep
+        ]
+    except OSError:
+        return
+    total = sum(sz for _, sz, _ in entries) + (
+        os.path.getsize(keep) if os.path.exists(keep) else 0)
+    for _, sz, p in sorted(entries):
+        if total <= budget * 1e9:
+            break
+        try:
+            os.unlink(p)
+            total -= sz
+        except OSError:
+            pass

@@ -28,6 +28,15 @@ os.environ.setdefault(
     "GOSSIP_TPU_COMPILE_CACHE", f"/tmp/jax_compile_cache-{os.getuid()}"
 )  # uid-scoped: concurrent users on one host must not collide on
    # file ownership in a shared world-writable cache dir
+# routed-plan cache kept out of ~/.cache AND per-session: a persistent
+# dir would let routed CLI tests load entries written by a different
+# code version and pass without exercising the current plan compiler
+# (FORMAT_VERSION guards on-disk layout, not compiler behavior)
+if "GOSSIP_TPU_PLAN_CACHE" not in os.environ:
+    import tempfile
+
+    os.environ["GOSSIP_TPU_PLAN_CACHE"] = tempfile.mkdtemp(
+        prefix="gossip_plan_cache_")
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

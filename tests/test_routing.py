@@ -156,6 +156,121 @@ def test_routed_diffusion_round_matches_scatter():
             == np.asarray(res["scatter"].converged)).mean() > 0.99
 
 
+def test_plan_cache_roundtrip_bitwise(tmp_path):
+    """A cache hit must load BITWISE the tables the build produced —
+    the cache is a pure serialization, never a different plan."""
+    from gossipprotocol_tpu.ops import plancache
+
+    topo = build_topology("powerlaw", 700, seed=13, m=3)
+    rd, state = plancache.routed_delivery_cached(
+        topo, cache_dir=str(tmp_path), device=False)
+    assert state == "miss"
+    rd2, state2 = plancache.routed_delivery_cached(
+        topo, cache_dir=str(tmp_path), device=False)
+    assert state2 == "hit"
+    leaves1, tree1 = jax.tree.flatten(rd)
+    leaves2, tree2 = jax.tree.flatten(rd2)
+    assert tree1 == tree2  # geometry (aux_data) identical
+    for a, b in zip(leaves1, leaves2):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # and the loaded delivery actually delivers
+    n = topo.num_nodes
+    rng = np.random.default_rng(8)
+    xs = rng.standard_normal(n).astype(np.float32)
+    xw = rng.standard_normal(n).astype(np.float32)
+    s1, w1 = rd.matvec(jnp.asarray(xs), jnp.asarray(xw), interpret=True)
+    s2, w2 = rd2.matvec(jnp.asarray(xs), jnp.asarray(xw), interpret=True)
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+    assert np.array_equal(np.asarray(w1), np.asarray(w2))
+
+
+def test_plan_cache_keyed_by_adjacency_and_version(tmp_path):
+    """Different graphs never collide; a format bump invalidates."""
+    from gossipprotocol_tpu.ops import plancache
+
+    t1 = build_topology("er", 400, seed=1, avg_degree=6.0)
+    t2 = build_topology("er", 400, seed=2, avg_degree=6.0)
+    r1, _ = plancache.routed_delivery_cached(
+        t1, cache_dir=str(tmp_path), device=False)
+    r2, s2 = plancache.routed_delivery_cached(
+        t2, cache_dir=str(tmp_path), device=False)
+    assert s2 == "miss"  # same kind/size, different graph: new entry
+    # corrupt entries fall back to rebuild, not a crash — both the
+    # non-zip and the truncated-zip (torn write) flavors, which numpy
+    # reports as different exception types
+    import os
+
+    path = plancache.entry_path(str(tmp_path), plancache.cache_key(t1))
+    blob = open(path, "rb").read()
+    with open(path, "wb") as fh:
+        fh.write(blob[: len(blob) // 2])  # truncated zip: BadZipFile
+    r1b, s1b = plancache.routed_delivery_cached(
+        t1, cache_dir=str(tmp_path), device=False)
+    assert s1b == "miss" and os.path.getsize(path) > 64
+    with open(path, "wb") as fh:
+        fh.write(b"not an npz")  # non-zip bytes: ValueError
+    r1c, s1c = plancache.routed_delivery_cached(
+        t1, cache_dir=str(tmp_path), device=False)
+    assert s1c == "miss" and os.path.getsize(path) > 64
+    # "none" disables: nothing new written
+    before = set(os.listdir(tmp_path))
+    _, s_off = plancache.routed_delivery_cached(
+        build_topology("er", 300, seed=3, avg_degree=4.0),
+        cache_dir="none", device=False)
+    assert s_off == "off" and set(os.listdir(tmp_path)) == before
+    # eviction: with a ~zero budget, writing a new entry drops the
+    # oldest other entries but always keeps the one just written
+    import os as _os
+
+    _os.environ["GOSSIP_TPU_PLAN_CACHE_GB"] = "0.000001"
+    try:
+        _, s3 = plancache.routed_delivery_cached(
+            build_topology("er", 350, seed=4, avg_degree=4.0),
+            cache_dir=str(tmp_path), device=False)
+        assert s3 == "miss"
+        left = [f for f in _os.listdir(tmp_path) if f.endswith(".npz")]
+        assert len(left) == 1  # only the just-written entry survives
+    finally:
+        del _os.environ["GOSSIP_TPU_PLAN_CACHE_GB"]
+
+
+def test_fused_router_fallback_equivalent(monkeypatch):
+    """With the native fused router unavailable, the numpy pipeline must
+    still produce an exact plan (don't-care slots may route differently
+    — any proper routing of the real entries is valid)."""
+    from gossipprotocol_tpu import native
+
+    monkeypatch.setattr(native, "route_tiles_full", lambda *a, **k: None)
+    rng = np.random.default_rng(17)
+    m = 3 * 8192
+    perm = rng.permutation(m).astype(np.int64)
+    plan = build_route_plan(perm, m_in=m, unit=2)
+    x = rng.standard_normal(3 * 16384).astype(np.float32)
+    y = apply_plan_np(plan, x)
+    k = np.arange(m)
+    for j in (0, 1):
+        assert np.array_equal(y[k * 2 + j], x[perm * 2 + j])
+
+
+def test_plan_build_rate_floor():
+    """Regression guard on routed_plan_build_s (VERDICT r4 weak #6): the
+    build is O(E) host work measured at ~100k directed edges/s at 200k
+    nodes on this 1-core rig; a 3x regression would silently re-open
+    the 37-minute stall the cache exists to close. Coarse floor: a
+    30k-node BA build must sustain >= 15k directed edges/s."""
+    import time
+
+    topo = build_topology("powerlaw", 30_000, seed=5, m=4)
+    t0 = time.perf_counter()
+    build_routed_delivery(topo, device=False)
+    dt = time.perf_counter() - t0
+    rate = topo.num_directed_edges / dt
+    assert rate >= 15_000, (
+        f"plan build rate {rate:.0f} edges/s under the 15k floor "
+        f"({topo.num_directed_edges} edges in {dt:.1f}s)")
+
+
 def test_routed_config_validation():
     with pytest.raises(ValueError, match="fanout-all"):
         RunConfig(algorithm="push-sum", fanout="one", delivery="routed")
