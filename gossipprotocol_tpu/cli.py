@@ -424,6 +424,13 @@ def main(argv=None) -> int:
                 "complete graph has none (diffusion on K_n mixes in one "
                 "round via two reductions) — use delivery='scatter'"
             )
+        if (args.devices > 1 and algo == "push-sum"
+                and args.semantics == "reference"):
+            raise ValueError(
+                "semantics='reference' push-sum is the single-token walk "
+                "(one MainPushSum in flight, Program.fs:128) — a serial "
+                "process that cannot shard; drop --devices"
+            )
         if args.auto_resume > 0 and args.devices > 1:
             raise ValueError(
                 "--auto-resume is single-process only: each process would "

@@ -397,6 +397,54 @@ def build_protocol(
                 else:
                     plat = dev.platform
                 core = partial(core, interpret=(plat != "tpu"))
+        elif ref:
+            # the reference's actual dynamics: a single-token random walk
+            # (one MainPushSum in flight, Program.fs:128; SURVEY §2.4.2).
+            # One engine round = one hop, so `rounds` is a hop count
+            # cross-validated against native.async_pushsum_hops.
+            from gossipprotocol_tpu.protocols.walk import (
+                pushsum_walk_init,
+                pushsum_walk_round,
+            )
+
+            if rows != n:
+                raise ValueError(
+                    "semantics='reference' push-sum is the single-token "
+                    "walk — a serial process that cannot shard; run it "
+                    "single-chip (the reference is single-process, "
+                    "Program.fs:36)"
+                )
+            if cfg.fault_plan:
+                raise ValueError(
+                    "semantics='reference' push-sum cannot take faults: "
+                    "killing the token holder hangs the walk exactly as "
+                    "an actor crash would hang the reference (SURVEY §5.3)"
+                )
+            if cfg.delivery != "scatter":
+                raise ValueError(
+                    "delivery variants invert/route the all-send "
+                    "deliveries; reference push-sum is the single-token "
+                    "walk and has nothing to invert — drop --delivery"
+                )
+            if cfg.seed_node is not None:
+                seed_node = cfg.seed_node
+                if (not topo.implicit_full
+                        and int(topo.degree[seed_node]) == 0):
+                    raise ValueError(
+                        f"seed node {seed_node} has no neighbors — the "
+                        "walk would be trapped forever (the reference "
+                        "would hang identically)"
+                    )
+            else:
+                # birth mask = giant component, where every node has a
+                # neighbor and neighbors stay in-component: the walk can
+                # never trap from a default start
+                seed_node = pick_seed_node(n, cfg.seed,
+                                           alive=topo.birth_alive())
+            state = pushsum_walk_init(
+                n, seed_node, value_mode=cfg.value_mode, dtype=cfg.dtype)
+            core = partial(
+                pushsum_walk_round, n=n, streak_target=cfg.streak_target)
         else:
             if cfg.delivery == "invert":
                 # loud config errors, not silent fallbacks (SURVEY.md §5.6)
@@ -573,7 +621,7 @@ def chunk_stats(state, done_fn) -> dict:
         "converged": jnp.sum((state.converged & state.alive).astype(jnp.int32)),
         "alive": jnp.sum(state.alive.astype(jnp.int32)),
     }
-    if isinstance(state, PushSumState):
+    if hasattr(state, "ratio"):  # PushSumState and the reference WalkState
         big = jnp.asarray(jnp.inf, state.ratio.dtype)
         rec["ratio_min"] = jnp.min(jnp.where(state.alive, state.ratio, big))
         rec["ratio_max"] = jnp.max(jnp.where(state.alive, state.ratio, -big))

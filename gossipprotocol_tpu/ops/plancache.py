@@ -211,18 +211,35 @@ def _evict_over_budget(cache_dir: str, keep: str) -> None:
         budget = float(os.environ.get("GOSSIP_TPU_PLAN_CACHE_GB", "20"))
     except ValueError:
         budget = 20.0
+    import time
+
     try:
-        entries = [
-            (os.path.getmtime(p), os.path.getsize(p), p)
-            for f in os.listdir(cache_dir)
-            if f.startswith("routed_v") and f.endswith(".npz")
-            # ".tmp<pid>.npz" is a concurrent writer's in-flight entry:
-            # unlinking it would crash that writer's os.replace publish
-            and ".tmp" not in f
-            and (p := os.path.join(cache_dir, f)) != keep
-        ]
+        listing = os.listdir(cache_dir)
     except OSError:
         return
+    entries = []
+    for f in listing:
+        if not (f.startswith("routed_v") and f.endswith(".npz")):
+            continue
+        p = os.path.join(cache_dir, f)
+        if p == keep:
+            continue
+        try:
+            mtime, sz = os.path.getmtime(p), os.path.getsize(p)
+        except OSError:
+            continue
+        if ".tmp" in f:
+            # a fresh ".tmp<pid>.npz" is a concurrent writer's in-flight
+            # entry (unlinking it would crash that writer's os.replace
+            # publish); a stale one is debris from a killed build — GBs
+            # that nothing else ever reclaims
+            if time.time() - mtime > 6 * 3600:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            continue
+        entries.append((mtime, sz, p))
     total = sum(sz for _, sz, _ in entries) + (
         os.path.getsize(keep) if os.path.exists(keep) else 0)
     for _, sz, p in sorted(entries):

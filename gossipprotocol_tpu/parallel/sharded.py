@@ -126,6 +126,13 @@ def _sharded_core(
             "scatter path's psum_scatter moves strictly less. Use "
             "delivery='scatter' on meshes."
         )
+    if ref:
+        raise ValueError(
+            "semantics='reference' push-sum is the single-token walk "
+            "(one MainPushSum in flight, Program.fs:128) — a serial "
+            "process that cannot shard; run it single-chip (the "
+            "reference is single-process anyway)"
+        )
     return partial(
         pushsum_round_core,
         n=n,

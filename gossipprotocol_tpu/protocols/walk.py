@@ -1,0 +1,177 @@
+"""The reference's *actual* push-sum dynamics: a single-token random walk.
+
+Because each ``MainPushSum`` handler sends exactly one message
+(``Program.fs:128``), the reference never runs a parallel protocol —
+exactly one ``(s, w)`` message exists in the whole system at any time
+(SURVEY.md §2.4.2, §3.3). Combined with the commit-before-compare bug
+(delta identically zero, ``Program.fs:109-114``) and ``count``
+initialized to 1 (``Program.fs:67``), a node "converges" upon receiving
+its **2nd** message, so the reference's reported convergence time is the
+2-cover time of a random walk.
+
+Rounds 1-4 emulated this with an all-nodes-send round under the broken
+predicate and owned the true dynamics in the C++ oracle
+(``native/asyncsim.cpp::async_pushsum_walk``). This module renders the
+walk **in the engine**: one engine round = one token hop, so
+``--semantics reference`` push-sum reproduces the reference end-to-end —
+receipt counting, post-convergence relays (``Program.fs:129-131``), the
+halve-and-forward mass dynamics — and its ``rounds`` output is directly
+a hop count, cross-validated against the oracle's distribution
+(tests/test_engine.py).
+
+A serial walk is one scalar update per round — the one protocol here
+that a TPU cannot parallelize, because the *reference semantics being
+rendered* are serial. It stays worthwhile on-device: the whole chunk of
+hops runs inside one ``lax.while_loop`` dispatch, so the host loop and
+tunnel round-trips amortize exactly like the parallel protocols'. The
+walk is single-chip by nature; the sharded engine rejects it loudly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu.protocols.sampling import (
+    CSRNeighbors,
+    DenseNeighbors,
+    InvertedDense,
+)
+
+
+class WalkState(NamedTuple):
+    """Per-node arrays mirror ``PushSumState``; three scalars carry the
+    token: its position and the in-flight ``(s, w)`` message (a relay
+    chain through converged nodes preserves the message unchanged, so it
+    cannot be reconstructed from node state)."""
+
+    s: jax.Array           # float[N]  node sum components
+    w: jax.Array           # float[N]  node weight components
+    ratio: jax.Array       # float[N]  current s/w estimates
+    streak: jax.Array      # int32[N]  the reference's ``count`` (starts 1)
+    converged: jax.Array   # bool[N]
+    alive: jax.Array       # bool[N]
+    round: jax.Array       # int32 scalar — hop count
+    cur: jax.Array         # int32 scalar — token position
+    msg_s: jax.Array       # float scalar — in-flight message
+    msg_w: jax.Array       # float scalar
+
+
+def pushsum_walk_init(
+    num_nodes: int,
+    start_node: int,
+    value_mode: str = "scaled",
+    dtype=jnp.float32,
+) -> WalkState:
+    """Initial walk state, seed message already emitted.
+
+    The driver's seed ``MainPushSum(0.0, 1.0, "start")`` makes the start
+    node halve its own pair and send one half (``Program.fs:102-106``) —
+    no receipt is counted there, exactly like the oracle's walk starting
+    *at* ``start_node`` with its first hop landing on a neighbor.
+    ``value_mode`` as in :func:`~gossipprotocol_tpu.protocols.state.
+    pushsum_init` (``"index"`` is the reference's ``s_i = i``).
+    """
+    i = jnp.arange(num_nodes, dtype=dtype)
+    s = i / num_nodes if value_mode == "scaled" else i
+    w = jnp.ones(num_nodes, dtype)
+    s = s.at[start_node].mul(0.5)
+    w = w.at[start_node].mul(0.5)
+    return WalkState(
+        s=s,
+        w=w,
+        ratio=s / jnp.maximum(w, jnp.asarray(1e-30, dtype)),
+        # the reference's ``count`` starts at 1 (Program.fs:67)
+        streak=jnp.ones(num_nodes, jnp.int32),
+        converged=jnp.zeros(num_nodes, bool),
+        alive=jnp.ones(num_nodes, bool),
+        round=jnp.int32(0),
+        cur=jnp.int32(start_node),
+        msg_s=s[start_node],
+        msg_w=w[start_node],
+    )
+
+
+def _draw_next(nbrs, n: int, key: jax.Array, cur: jax.Array):
+    """(target, movable): one uniform neighbor draw for the token holder.
+
+    The reference draws with a fresh ``Random()`` per message
+    (``Program.fs:128,130``); here the draw is counter-based on the hop
+    number — deterministic replay, same as every other sampler in
+    :mod:`protocols.sampling`. ``movable=False`` means the holder has no
+    neighbors (a trapped walk — build_protocol rejects the only config
+    that could produce one, an explicitly isolated --seed-node).
+    """
+    if nbrs is None:  # implicit complete graph: uniform over [0, n) \ {cur}
+        t = jax.random.randint(key, (), 0, n - 1)
+        t = jnp.where(t >= cur, t + 1, t).astype(jnp.int32)
+        return t, jnp.bool_(n > 1)
+    if isinstance(nbrs, (DenseNeighbors, InvertedDense)):
+        deg = nbrs.degree[cur]
+        j = jax.random.randint(key, (), 0, jnp.maximum(deg, 1))
+        return nbrs.table[cur, j], deg > 0
+    assert isinstance(nbrs, CSRNeighbors)
+    deg = nbrs.degree[cur]
+    j = jax.random.randint(key, (), 0, jnp.maximum(deg, 1))
+    return nbrs.indices[nbrs.starts[cur] + j], deg > 0
+
+
+@partial(jax.jit, static_argnames=("n", "streak_target"), inline=True)
+def pushsum_walk_round(
+    state: WalkState,
+    nbrs,  # CSRNeighbors | DenseNeighbors | InvertedDense | None
+    base_key: jax.Array,
+    *,
+    n: int,
+    streak_target: int = 3,
+) -> WalkState:
+    """One token hop (= one engine round), ``Program.fs:107-131`` exactly:
+
+    the holder sends to a uniform neighbor; an unconverged receiver
+    accumulates, advances ``count`` (the delta it should gate on is
+    identically zero — the commit-before-compare bug), converges at
+    ``count = streak_target``, halves its pair and forwards one half; a
+    converged receiver relays the message untouched.
+    """
+    key = jax.random.fold_in(base_key, state.round)
+    tgt, movable = _draw_next(nbrs, n, key, state.cur)
+
+    relay = state.converged[tgt]
+    s_acc = state.s[tgt] + state.msg_s
+    w_acc = state.w[tgt] + state.msg_w
+    count = state.streak[tgt] + 1
+    newly = count >= streak_target
+    s_half = s_acc * 0.5
+    w_half = w_acc * 0.5
+
+    s = state.s.at[tgt].set(jnp.where(relay, state.s[tgt], s_half))
+    w = state.w.at[tgt].set(jnp.where(relay, state.w[tgt], w_half))
+    streak = state.streak.at[tgt].set(
+        jnp.where(relay, state.streak[tgt], count))
+    converged = state.converged.at[tgt].set(relay | newly)
+    ratio = state.ratio.at[tgt].set(
+        s[tgt] / jnp.maximum(w[tgt], jnp.asarray(1e-30, state.w.dtype)))
+
+    # a trapped token (no neighbors) stays put and changes nothing —
+    # unreachable from a default start (the seed lands in the giant
+    # component and the walk cannot leave it; build_protocol rejects an
+    # explicit isolated --seed-node), guarded anyway so a hand-built
+    # state can never emit garbage draws
+    def keep(new, old):
+        return jnp.where(movable, new, old)
+
+    return WalkState(
+        s=keep(s, state.s),
+        w=keep(w, state.w),
+        ratio=keep(ratio, state.ratio),
+        streak=keep(streak, state.streak),
+        converged=keep(converged, state.converged),
+        alive=state.alive,
+        round=state.round + 1,
+        cur=keep(tgt, state.cur),
+        msg_s=keep(jnp.where(relay, state.msg_s, s_half), state.msg_s),
+        msg_w=keep(jnp.where(relay, state.msg_w, w_half), state.msg_w),
+    )

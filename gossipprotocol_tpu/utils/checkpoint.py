@@ -19,8 +19,10 @@ import jax
 import numpy as np
 
 from gossipprotocol_tpu.protocols.state import GossipState, PushSumState
+from gossipprotocol_tpu.protocols.walk import WalkState
 
-_STATE_TYPES = {"GossipState": GossipState, "PushSumState": PushSumState}
+_STATE_TYPES = {"GossipState": GossipState, "PushSumState": PushSumState,
+                "WalkState": WalkState}
 
 # Every RunConfig field that influences the trajectory. Saved in checkpoint
 # metadata and compared generically on resume — resuming under a different

@@ -33,10 +33,13 @@ os.environ.setdefault(
 # code version and pass without exercising the current plan compiler
 # (FORMAT_VERSION guards on-disk layout, not compiler behavior)
 if "GOSSIP_TPU_PLAN_CACHE" not in os.environ:
+    import atexit
+    import shutil
     import tempfile
 
-    os.environ["GOSSIP_TPU_PLAN_CACHE"] = tempfile.mkdtemp(
-        prefix="gossip_plan_cache_")
+    _plan_cache_dir = tempfile.mkdtemp(prefix="gossip_plan_cache_")
+    os.environ["GOSSIP_TPU_PLAN_CACHE"] = _plan_cache_dir
+    atexit.register(shutil.rmtree, _plan_cache_dir, ignore_errors=True)
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
