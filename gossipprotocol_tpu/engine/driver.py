@@ -247,15 +247,20 @@ class RunResult:
         reports the gap between component means, not a protocol error.
         """
         st = self.final_state
-        if not isinstance(st, PushSumState):
+        if not hasattr(st, "ratio"):  # PushSumState or the WalkState
             return None
         ratio = np.asarray(st.ratio, dtype=np.float64)
         alive = np.asarray(st.alive)
         if not alive.any():
             return None
-        s = np.asarray(st.s, np.float64)[alive]
-        w = np.asarray(st.w, np.float64)[alive]
-        true_mean = float(s.sum() / w.sum())
+        s = np.asarray(st.s, np.float64)[alive].sum()
+        w = np.asarray(st.w, np.float64)[alive].sum()
+        if hasattr(st, "msg_s"):
+            # the walk's in-flight token carries real mass (its holder is
+            # always an alive node); the reachable mean includes it
+            s += float(st.msg_s)
+            w += float(st.msg_w)
+        true_mean = float(s / w)
         return float(np.abs(ratio[alive] - true_mean).max())
 
 
@@ -349,10 +354,14 @@ def build_protocol(
             "spreading": gossip_spreading_count(s, keep_alive)
         }
     else:
-        state = pushsum_init(
-            rows, value_mode=cfg.value_mode, dtype=cfg.dtype,
-            reference_semantics=ref, real_nodes=n,
-        )
+        if not ref or cfg.fanout == "all":
+            # (the walk branch below builds its own WalkState; fanout=all
+            # + reference is rejected by RunConfig, so this condition is
+            # exactly "not the walk")
+            state = pushsum_init(
+                rows, value_mode=cfg.value_mode, dtype=cfg.dtype,
+                reference_semantics=ref, real_nodes=n,
+            )
         if cfg.fanout == "all":
             from gossipprotocol_tpu.protocols.diffusion import (
                 pushsum_diffusion_round,
@@ -428,12 +437,15 @@ def build_protocol(
                 )
             if cfg.seed_node is not None:
                 seed_node = cfg.seed_node
+                birth = topo.birth_alive()
                 if (not topo.implicit_full
-                        and int(topo.degree[seed_node]) == 0):
+                        and int(topo.degree[seed_node]) == 0) or (
+                        birth is not None and not bool(birth[seed_node])):
                     raise ValueError(
-                        f"seed node {seed_node} has no neighbors — the "
-                        "walk would be trapped forever (the reference "
-                        "would hang identically)"
+                        f"seed node {seed_node} has no neighbors or sits "
+                        "in a birth-excluded minority component — the "
+                        "walk would be trapped there forever (the "
+                        "reference would hang identically)"
                     )
             else:
                 # birth mask = giant component, where every node has a

@@ -17,7 +17,7 @@ walk **in the engine**: one engine round = one token hop, so
 receipt counting, post-convergence relays (``Program.fs:129-131``), the
 halve-and-forward mass dynamics — and its ``rounds`` output is directly
 a hop count, cross-validated against the oracle's distribution
-(tests/test_engine.py).
+(tests/test_walk.py).
 
 A serial walk is one scalar update per round — the one protocol here
 that a TPU cannot parallelize, because the *reference semantics being

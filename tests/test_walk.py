@@ -35,6 +35,11 @@ def test_walk_is_selected_and_conserves_mass():
     assert abs(total - expected) < 1e-4
     total_w = float(np.sum(st.w) + st.msg_w)
     assert abs(total_w - 32.0) < 1e-4
+    # the accuracy metric covers walk results too (token mass included in
+    # the reachable mean); the broken predicate means the walk stops far
+    # from the true mean, but the number must exist and be finite
+    err = res.estimate_error
+    assert err is not None and np.isfinite(err)
 
 
 def test_walk_hops_within_oracle_band(native_oracle):
@@ -128,6 +133,16 @@ def test_walk_rejects_sharding_faults_and_trapped_seed(capsys):
     from gossipprotocol_tpu.topology.builders import add_isolated_rows
 
     topo = add_isolated_rows(build_topology("3D", 27))
-    with pytest.raises(ValueError, match="no neighbors"):
+    with pytest.raises(ValueError, match="no neighbors|trapped"):
         build_protocol(topo, RunConfig(
             algorithm="push-sum", semantics="reference", seed_node=27))
+    # a seed in a birth-excluded minority component traps the walk just
+    # as surely as a degree-0 seed — must be loud, not a silent grind
+    from gossipprotocol_tpu.topology.base import csr_from_edges
+
+    island = csr_from_edges(
+        6, np.array([[0, 1], [1, 2], [2, 3], [3, 0], [4, 5]]), kind="er")
+    assert island.birth_alive() is not None
+    with pytest.raises(ValueError, match="minority|trapped"):
+        build_protocol(island, RunConfig(
+            algorithm="push-sum", semantics="reference", seed_node=4))
