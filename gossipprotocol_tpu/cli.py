@@ -213,10 +213,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "faults; measured 9x slower on TPU v5e, a validated "
                         "negative result, see README). fanout-all: "
                         "'routed' replaces the per-edge scatters with "
-                        "static Clos routing plans (single-chip, f32, "
-                        "component-closed dead sets; trajectories agree "
-                        "with scatter to float accumulation order; "
-                        "measured ~7x faster at 10M power-law)")
+                        "static Clos routing plans (f32, component-"
+                        "closed dead sets; trajectories agree with "
+                        "scatter to float accumulation order; measured "
+                        "21x faster at 10M power-law). Under --devices N "
+                        "each shard runs a directed per-shard plan after "
+                        "one all_gather — bitwise the single-chip "
+                        "trajectory")
     p.add_argument("--plan-cache", type=str, default=None, metavar="DIR",
                    help="routed-delivery plan cache directory (default "
                         "$GOSSIP_TPU_PLAN_CACHE or "
@@ -412,12 +415,6 @@ def main(argv=None) -> int:
                     "delivery='invert' is single-chip only — drop --devices "
                     "or use delivery='scatter'"
                 )
-        if cfg.delivery == "routed" and args.devices > 1:
-            raise ValueError(
-                "delivery='routed' is single-chip only (the routing plans "
-                "address one chip's HBM) — drop --devices or use "
-                "delivery='scatter'"
-            )
         if cfg.delivery == "routed" and topo.implicit_full:
             raise ValueError(
                 "delivery='routed' needs an explicit edge list; the "
@@ -431,6 +428,21 @@ def main(argv=None) -> int:
                 "(one MainPushSum in flight, Program.fs:128) — a serial "
                 "process that cannot shard; drop --devices"
             )
+        if args.devices > 1:
+            import jax as _jax
+
+            try:
+                avail = len(_jax.devices(
+                    None if args.backend == "auto" else args.backend))
+            except RuntimeError as e:
+                raise ValueError(f"backend {args.backend!r}: {e}")
+            if avail < args.devices:
+                raise ValueError(
+                    f"requested {args.devices} devices, only {avail} "
+                    f"visible on backend {args.backend!r} (the CPU test "
+                    "mesh needs XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=N)"
+                )
         if args.auto_resume > 0 and args.devices > 1:
             raise ValueError(
                 "--auto-resume is single-process only: each process would "

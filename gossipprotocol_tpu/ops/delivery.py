@@ -62,6 +62,95 @@ def _ceil_pow2(x: np.ndarray) -> np.ndarray:
     return (1 << np.ceil(np.log2(x)).astype(np.int64)).astype(np.int64)
 
 
+def degree_classes(degree: np.ndarray) -> np.ndarray:
+    """Per-node delivery class: ceil-pow2 of degree, with the 128/256
+    band merged up to 512 (between the lane kernels' and the row
+    kernels' operating ranges — see the class-merge comment in
+    :func:`build_routed_delivery`); degree-0 nodes get class 0."""
+    cls = _ceil_pow2(degree)
+    cls[(cls > 64) & (cls < 512)] = 512
+    cls[np.asarray(degree) == 0] = 0
+    return cls
+
+
+def class_order(cls: np.ndarray, n: int, shuffle_seed: int = 0xC105):
+    """(order, rank, nu): class-major node order with the load-bearing
+    within-class shuffle (see the build comment: sorted orders make the
+    delivery permutations near block-diagonal and blow up the radix
+    capacities; the shuffle spreads them)."""
+    order = np.argsort(np.where(cls == 0, np.iinfo(np.int64).max, cls),
+                       kind="stable")
+    nu = int((cls > 0).sum())
+    order = order[:nu]
+    rng = np.random.default_rng(shuffle_seed)
+    c_tmp = cls[order]
+    bounds = np.r_[0, np.flatnonzero(np.diff(c_tmp)) + 1, nu]
+    for i, j in zip(bounds[:-1], bounds[1:]):
+        order[i:j] = order[i + rng.permutation(j - i)]
+    rank = np.full(n, -1, np.int64)
+    rank[order] = np.arange(nu)
+    return order, rank, nu
+
+
+def class_layout(c_sorted: np.ndarray, caps: dict | None = None):
+    """(classes, node_start_pair, m_pairs, cap_node_pos) from the sorted
+    class vector.
+
+    Pallas-aligned regions (see ops/classops): small classes pad to
+    BLK-row multiples with phantom node slots; big classes cover whole
+    rows by construction.
+
+    ``caps``: optional forced per-class node-capacity minima
+    (``{class: n_c_min}``) — the geometry-uniformization hook for
+    per-shard plans under shard_map, which needs every shard's layout
+    identical; capacities are cross-shard maxima, and classes present in
+    ``caps`` but absent from this shard's data are injected with
+    ``n_c = 0`` so the classes tuple (and therefore the compiled
+    program) matches on every shard.
+
+    ``cap_node_pos``: int64 [nu] — each dense-ordered node's position in
+    the *capacity-padded* node-slot sequence (classes occupy ``cap``
+    node slots each). The symmetric single-chip delivery addresses the
+    dense sequence; shard deliveries address the padded one so their
+    control flow is capacity- (not count-) driven.
+    """
+    from gossipprotocol_tpu.ops.classops import BLK
+
+    nu = len(c_sorted)
+    if nu:
+        cb = np.r_[0, np.flatnonzero(np.diff(c_sorted)) + 1, nu]
+        present = {int(c_sorted[i]): (int(i), int(j))
+                   for i, j in zip(cb[:-1], cb[1:])}
+    else:
+        present = {}
+    all_cls = sorted(set(present) | set(caps or {}))
+    classes = []
+    node_start_pair = np.zeros(nu, np.int64)
+    cap_node_pos = np.zeros(nu, np.int64)
+    cursor = 0
+    cap_nodes = 0
+    for c in all_cls:
+        i, j = present.get(c, (0, 0))
+        n_c = j - i
+        n_eff = max(n_c, (caps or {}).get(c, 0))
+        if n_eff == 0:
+            continue
+        if 2 * c <= 128:
+            rows = -(-(n_eff * 2 * c) // 128)
+            rows = -(-rows // BLK) * BLK
+            cap = rows * 128 // (2 * c)
+        else:
+            q = (2 * c) // 128
+            rows = n_eff * q
+            cap = n_eff
+        node_start_pair[i:j] = cursor + np.arange(n_c, dtype=np.int64) * c
+        cap_node_pos[i:j] = cap_nodes + np.arange(n_c, dtype=np.int64)
+        classes.append((c, n_c, int(cursor), int(rows), int(cap)))
+        cursor += cap * c
+        cap_nodes += cap
+    return tuple(classes), node_start_pair, int(cursor), cap_node_pos
+
+
 # --- pytree registration: geometry static, tables dynamic ----------------
 
 def _register():
@@ -224,14 +313,12 @@ def build_routed_delivery(topo: Topology, progress=None,
     offsets = np.asarray(topo.offsets, np.int64)
     indices = np.asarray(topo.indices, np.int64)
     degree = np.diff(offsets)
-    cls = _ceil_pow2(degree)
     # classes 128/256 (runs of 2-4 whole rows) sit between the lane
     # kernels (runs within one row) and the row kernels (runs of >= 8
-    # rows, the Mosaic sublane-block minimum) — merge them up to 512.
-    # Cost: <= 8x slot padding on the degree-65..256 band, ~0.4% of a
-    # BA graph's nodes; ER never has such degrees.
-    cls[(cls > 64) & (cls < 512)] = 512
-    cls[degree == 0] = 0
+    # rows, the Mosaic sublane-block minimum) — degree_classes merges
+    # them up to 512. Cost: <= 8x slot padding on the degree-65..256
+    # band, ~0.4% of a BA graph's nodes; ER never has such degrees.
+    cls = degree_classes(degree)
 
     # class-major node order; WITHIN each class the order is shuffled
     # (seeded, deterministic). This is load-bearing, not cosmetic: the
@@ -242,46 +329,14 @@ def build_routed_delivery(topo: Topology, progress=None,
     # diagonal), concentrating whole tiles into single buckets — CR blew
     # up to 64 rows and the final merge to K=39 stacked tiles before
     # this shuffle (measured at 60K BA m=4).
-    order = np.argsort(np.where(cls == 0, np.iinfo(np.int64).max, cls),
-                       kind="stable")
-    nu = int((degree > 0).sum())
-    order = order[:nu]                       # degree-0 nodes excluded
-    rng = np.random.default_rng(0xC105)
-    c_tmp = cls[order]
-    bounds = np.r_[0, np.flatnonzero(np.diff(c_tmp)) + 1, nu]
-    for i, j in zip(bounds[:-1], bounds[1:]):
-        order[i:j] = order[i + rng.permutation(j - i)]
-    rank = np.full(n, -1, np.int64)
-    rank[order] = np.arange(nu)
+    order, rank, nu = class_order(cls, n)
 
-    c_sorted = cls[order]
     # class segment table with Pallas-aligned regions (see ops/classops):
     # small classes (2c <= 128 lanes) pad their region to BLK-row
     # multiples with phantom node slots; big classes cover whole rows by
     # construction. Phantom/class-pad slots are -1 (never routed) and
     # read as exact zeros out of the final pass.
-    from gossipprotocol_tpu.ops.classops import BLK
-
-    cb = np.r_[0, np.flatnonzero(np.diff(c_sorted)) + 1, nu]
-    classes = []
-    node_start_pair = np.zeros(nu, np.int64)
-    cursor = 0
-    for i, j in zip(cb[:-1], cb[1:]):
-        c = int(c_sorted[i])
-        n_c = int(j - i)
-        if 2 * c <= 128:
-            rows = -(-(n_c * 2 * c) // 128)
-            rows = -(-rows // BLK) * BLK
-            cap = rows * 128 // (2 * c)
-        else:
-            q = (2 * c) // 128
-            rows = n_c * q
-            cap = n_c
-        node_start_pair[i:j] = cursor + np.arange(n_c, dtype=np.int64) * c
-        classes.append((c, n_c, int(cursor), int(rows), int(cap)))
-        cursor += cap * c
-    classes = tuple(classes)
-    m_pairs = int(cursor)
+    classes, node_start_pair, m_pairs, _ = class_layout(cls[order])
 
     if progress:
         progress(f"routed delivery: n={n} nu={nu} m_pairs={m_pairs} "
@@ -379,13 +434,19 @@ def _check_geometry(name: str, p) -> None:
 
 
 def _chained_plans(src_of: np.ndarray, m_in: int, progress=None,
-                   unit: int = 2):
+                   unit: int = 2, cr_floors=None,
+                   geometry_only: bool = False):
     """Two well-spread plans implementing one structured permutation.
 
     rho(i) = i * P mod m (P coprime to m): every contiguous input band
     spreads uniformly over output tiles, so BOTH rho and
     (src_of o rho^-1) route with minimal capacities regardless of how
     clustered ``src_of`` is.  Returns plans applied left-to-right.
+
+    ``cr_floors``: pair of per-stage capacity-floor tuples (one per
+    chained plan) and ``geometry_only`` — both forwarded to
+    :func:`~gossipprotocol_tpu.ops.plan.build_route_plan` for the
+    cross-shard geometry uniformization (see ops/sharddelivery.py).
     """
     m = int(m_in)
     p_stride = _coprime_stride(m)
@@ -393,11 +454,14 @@ def _chained_plans(src_of: np.ndarray, m_in: int, progress=None,
     rho = (k * p_stride) % m                 # out slot j <- in slot rho[j]
     rho_inv = np.empty(m, np.int64)
     rho_inv[rho] = k
+    f1, f2 = cr_floors if cr_floors is not None else (None, None)
     plan1 = plan_mod.build_route_plan(rho, m_in=m, unit=unit,
-                                      progress=progress)
+                                      progress=progress, cr_floors=f1,
+                                      geometry_only=geometry_only)
     src2 = np.where(src_of >= 0, rho_inv[np.clip(src_of, 0, m - 1)], -1)
     plan2 = plan_mod.build_route_plan(src2, m_in=m, unit=unit,
-                                      progress=progress)
+                                      progress=progress, cr_floors=f2,
+                                      geometry_only=geometry_only)
     _check_geometry("stride plan", plan1)
     _check_geometry("descrambled plan", plan2)
     return (plan1, plan2)

@@ -144,8 +144,19 @@ def _routed_idx(perm: np.ndarray, unit: int) -> np.ndarray:
 
 
 def build_route_plan(src_of: np.ndarray, m_in: int, unit: int = 2,
-                     progress=None) -> RoutePlan:
-    """Compile the permutation into a radix pipeline plan."""
+                     progress=None, cr_floors=None,
+                     geometry_only: bool = False) -> RoutePlan:
+    """Compile the permutation into a radix pipeline plan.
+
+    ``cr_floors``: optional per-stage run-capacity minima (each a pow2
+    ≤ 128) — the geometry-uniformization hook for per-shard plans under
+    shard_map: every stage's ``cr`` (and everything derived: ``o``,
+    ``tau_slab``, the final merge ``k``) is data-dependent, and shards
+    must share ONE geometry, so callers force each to the cross-shard
+    maximum. ``geometry_only=True`` skips the (expensive) tile routing
+    and returns a plan whose ``idx`` arrays are None — just enough to
+    *read* the geometry for computing those maxima cheaply.
+    """
     src_of = np.asarray(src_of, np.int64)
     u = TILE // unit
     nt_out = max(1, -(-len(src_of) // u))
@@ -187,6 +198,8 @@ def build_route_plan(src_of: np.ndarray, m_in: int, unit: int = 2,
         upr = 128 // unit
         max_rows = int(-(-run_len.max() // upr)) if key.size else 1
         cr = _pow2_cr(max_rows)
+        if cr_floors is not None and stage_no - 1 < len(cr_floors):
+            cr = max(cr, int(cr_floors[stage_no - 1]))
         o = -(-b * cr // 128)
         tau_slab = -(-(tau_in * cr) // 128) * (128 // cr)
         # output stacked-slot of each flow within its input tile's o tiles
@@ -201,12 +214,16 @@ def build_route_plan(src_of: np.ndarray, m_in: int, unit: int = 2,
         new_pos = g_row * upr + rank % upr
         # per-(tile, o) bijections
         t_grid = p_regions * tau_in
-        perm = np.full((t_grid * o, u), -1, np.int64)
-        which_o = out_slot // u
-        perm[tile_o * o + which_o, out_slot % u] = pos_o % u
-        if progress:
-            progress(f"stage {stage_no}: routing {t_grid * o} tile perms")
-        idx = _routed_idx(perm, unit).reshape(t_grid, o, 3, 128, 128)
+        if geometry_only:
+            idx = None
+        else:
+            perm = np.full((t_grid * o, u), -1, np.int64)
+            which_o = out_slot // u
+            perm[tile_o * o + which_o, out_slot % u] = pos_o % u
+            if progress:
+                progress(
+                    f"stage {stage_no}: routing {t_grid * o} tile perms")
+            idx = _routed_idx(perm, unit).reshape(t_grid, o, 3, 128, 128)
         stages.append(StagePass(p_regions, tau_in, b, cr, o, tau_slab, idx))
         # advance flow positions (undo the sort)
         pos[order] = new_pos
@@ -220,6 +237,9 @@ def build_route_plan(src_of: np.ndarray, m_in: int, unit: int = 2,
     reg = tile // k
     if real.size and not (reg == ft).all():
         raise AssertionError("flows not in their final region (bug)")
+    if geometry_only:
+        return RoutePlan(unit, u, nt_in, nt_out, tuple(stages),
+                         FinalPass(k, None, None))
     perm = np.full((nt_out * k, u), -1, np.int64)
     stacked = tile - reg * k                   # which of the K inputs
     perm[ft * k + stacked, real % u] = pos % u

@@ -199,6 +199,122 @@ def routed_delivery_cached(topo, cache_dir: Optional[str] = None,
     return (to_device(rd) if device else rd), "miss"
 
 
+# ---- sharded (directed per-shard) deliveries ---------------------------
+
+def shard_entry_path(cache_dir: str, key: str, n_padded: int,
+                     num_shards: int) -> str:
+    return os.path.join(
+        cache_dir,
+        f"routedsh_v{FORMAT_VERSION}_{key}_p{n_padded}x{num_shards}.npz")
+
+
+def save_shards(stacked, path: str) -> None:
+    """Serialize a stacked ShardRoutedDelivery (numpy leaves, leading
+    shard axis — exactly what build_shard_deliveries returns)."""
+    arrays: dict = {}
+    meta = {
+        "format": FORMAT_VERSION,
+        "n": stacked.n, "local_n": stacked.local_n,
+        "nu_src": stacked.nu_src, "nu_tgt": stacked.nu_tgt,
+        "m_pairs_src": stacked.m_pairs_src,
+        "m_pairs_tgt": stacked.m_pairs_tgt,
+        "classes_src": [list(c) for c in stacked.classes_src],
+        "classes_tgt": [list(c) for c in stacked.classes_tgt],
+        "realmask_shape": list(stacked.realmask.shape),
+    }
+    for group in _PLAN_GROUPS:
+        plans = getattr(stacked, group)
+        meta[group] = [
+            _pack_plan(f"{group}{i}", dp, arrays)
+            for i, dp in enumerate(plans)
+        ]
+    arrays["realmask_bits"] = np.packbits(
+        np.asarray(stacked.realmask).astype(bool))
+    arrays["degree"] = np.asarray(stacked.degree, np.int32)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}.npz"
+    try:
+        np.savez(tmp, __meta__=json.dumps(meta), **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_shards(path: str):
+    """Stacked ShardRoutedDelivery from a cache entry, or None."""
+    from gossipprotocol_tpu.ops.sharddelivery import ShardRoutedDelivery
+
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+            if meta.get("format") != FORMAT_VERSION:
+                return None
+            shape = tuple(meta["realmask_shape"])
+            count = int(np.prod(shape))
+            realmask = np.unpackbits(
+                z["realmask_bits"], count=count
+            ).astype(np.float32).reshape(shape)
+            try:
+                os.utime(path)
+            except OSError:
+                pass
+            return ShardRoutedDelivery(
+                n=meta["n"], local_n=meta["local_n"],
+                nu_src=meta["nu_src"], nu_tgt=meta["nu_tgt"],
+                m_pairs_src=meta["m_pairs_src"],
+                m_pairs_tgt=meta["m_pairs_tgt"],
+                classes_src=tuple(tuple(c) for c in meta["classes_src"]),
+                classes_tgt=tuple(tuple(c) for c in meta["classes_tgt"]),
+                plan_in=tuple(_unpack_plan(f"plan_in{i}", m, z)
+                              for i, m in enumerate(meta["plan_in"])),
+                plan_m=tuple(_unpack_plan(f"plan_m{i}", m, z)
+                             for i, m in enumerate(meta["plan_m"])),
+                plan_out=tuple(_unpack_plan(f"plan_out{i}", m, z)
+                               for i, m in enumerate(meta["plan_out"])),
+                realmask=realmask,
+                degree=z["degree"],
+            )
+    except (OSError, ValueError, KeyError, json.JSONDecodeError,
+            zipfile.BadZipFile):
+        return None
+
+
+def shard_deliveries_cached(topo, n_padded: int, num_shards: int,
+                            cache_dir: str | None = None, progress=None):
+    """Cache-aware build_shard_deliveries, same policy as
+    :func:`routed_delivery_cached` (entries keyed by adjacency hash +
+    the mesh partition, since the plans depend on both)."""
+    from gossipprotocol_tpu.ops.sharddelivery import build_shard_deliveries
+
+    cache_dir = cache_dir or default_cache_dir()
+    if cache_dir == "none":
+        return build_shard_deliveries(topo, n_padded, num_shards,
+                                      progress=progress), "off"
+    path = shard_entry_path(cache_dir, cache_key(topo), n_padded,
+                            num_shards)
+    stacked = load_shards(path)
+    if stacked is not None:
+        if progress:
+            progress(f"sharded routed delivery: plan cache hit ({path})")
+        return stacked, "hit"
+    stacked = build_shard_deliveries(topo, n_padded, num_shards,
+                                     progress=progress)
+    try:
+        save_shards(stacked, path)
+        _evict_over_budget(cache_dir, keep=path)
+        if progress:
+            progress(f"sharded routed delivery: plans cached ({path})")
+    except OSError as e:
+        import warnings
+
+        warnings.warn(f"sharded plan cache write failed ({e}); "
+                      "continuing uncached")
+    return stacked, "miss"
+
+
 def _evict_over_budget(cache_dir: str, keep: str) -> None:
     """Drop oldest entries past ``$GOSSIP_TPU_PLAN_CACHE_GB`` (default 20).
 
@@ -219,7 +335,9 @@ def _evict_over_budget(cache_dir: str, keep: str) -> None:
         return
     entries = []
     for f in listing:
-        if not (f.startswith("routed_v") and f.endswith(".npz")):
+        # covers both entry families: "routed_v*" (single-chip) and
+        # "routedsh_v*" (sharded)
+        if not (f.startswith("routed") and f.endswith(".npz")):
             continue
         p = os.path.join(cache_dir, f)
         if p == keep:

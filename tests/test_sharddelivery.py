@@ -1,0 +1,181 @@
+"""Directed per-shard routed delivery (ops/sharddelivery.py): the
+compiler behind the sharded-routed design
+(artifacts/sharded_routed_assessment.json)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gossipprotocol_tpu import build_topology
+from gossipprotocol_tpu.ops.delivery import build_routed_delivery
+from gossipprotocol_tpu.ops.sharddelivery import build_shard_delivery
+
+
+@pytest.mark.parametrize("name,kw", [
+    ("er", dict(avg_degree=6.0)),
+    ("powerlaw", dict(m=3)),
+    ("3D", {}),
+])
+def test_shard_deliveries_reassemble_full_matvec(name, kw):
+    """Concatenating every shard's directed matvec must reproduce the
+    symmetric whole-graph delivery. Per-target sums traverse the same
+    values in the same in-row order through the same reduce tree, so the
+    match is bitwise, not just close."""
+    topo = build_topology(name, 700, seed=7, **kw)
+    n = topo.num_nodes
+    rng = np.random.default_rng(5)
+    xs = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    xw = jnp.asarray(rng.standard_normal(n), jnp.float32)
+
+    full = build_routed_delivery(topo, device=False)
+    ref_s, ref_w = full.matvec(xs, xw, interpret=True)
+
+    shards = 4
+    bounds = [n * k // shards for k in range(shards + 1)]
+    got_s, got_w = [], []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        sd = build_shard_delivery(topo, lo, hi)
+        s, w = sd.matvec(xs, xw, interpret=True)
+        assert s.shape[0] == hi - lo
+        got_s.append(np.asarray(s))
+        got_w.append(np.asarray(w))
+    np.testing.assert_array_equal(
+        np.concatenate(got_s), np.asarray(ref_s)[:n])
+    np.testing.assert_array_equal(
+        np.concatenate(got_w), np.asarray(ref_w)[:n])
+
+
+def test_forced_caps_uniformize_geometry():
+    """The shard_map prerequisite: shards built with cross-shard-max
+    capacities share one geometry (identical aux_data), so their tables
+    can stack on a leading device axis under a single program."""
+    import jax
+
+    topo = build_topology("er", 900, seed=3, avg_degree=8.0)
+    n = topo.num_nodes
+    shards = 4
+    bounds = [n * k // shards for k in range(shards + 1)]
+    naturals = [build_shard_delivery(topo, lo, hi)
+                for lo, hi in zip(bounds[:-1], bounds[1:])]
+
+    def caps_of(classes):
+        return {c: cap for c, _, _, _, cap in classes}
+
+    caps_src: dict = {}
+    caps_tgt: dict = {}
+    for sd in naturals:
+        for c, cap in caps_of(sd.classes_src).items():
+            caps_src[c] = max(caps_src.get(c, 0), cap)
+        for c, cap in caps_of(sd.classes_tgt).items():
+            caps_tgt[c] = max(caps_tgt.get(c, 0), cap)
+
+    uniform = [build_shard_delivery(topo, lo, hi, caps_src=caps_src,
+                                    caps_tgt=caps_tgt)
+               for lo, hi in zip(bounds[:-1], bounds[1:])]
+    auxes = []
+    for sd in uniform:
+        leaves, treedef = jax.tree.flatten(sd)
+        # local_n and the per-shard real counts (n_c) legitimately
+        # differ; everything the compiled program depends on must not
+        aux = (sd.n, sd.nu_src, sd.nu_tgt, sd.m_pairs_src,
+               sd.m_pairs_tgt,
+               tuple((c, start, rows, cap)
+                     for c, _, start, rows, cap in sd.classes_src),
+               tuple((c, start, rows, cap)
+                     for c, _, start, rows, cap in sd.classes_tgt),
+               tuple((x.shape, str(x.dtype)) for x in leaves))
+        auxes.append(aux)
+    assert all(a == auxes[0] for a in auxes), "geometry not uniform"
+
+    # and the uniformized shards still deliver exactly
+    rng = np.random.default_rng(9)
+    xs = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    xw = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    full = build_routed_delivery(topo, device=False)
+    ref_s, _ = full.matvec(xs, xw, interpret=True)
+    got = np.concatenate([
+        np.asarray(sd.matvec(xs, xw, interpret=True)[0])
+        for sd in uniform])
+    np.testing.assert_array_equal(got, np.asarray(ref_s)[:n])
+
+
+def test_stacked_deliveries_padded_bounds_bitwise():
+    """build_shard_deliveries: forced cr floors + caps give one program
+    (shard 0's treedef carries every shard's tables), including the
+    padded last shard — each slice reproduces the symmetric matvec
+    bitwise."""
+    import jax
+
+    from gossipprotocol_tpu.ops.sharddelivery import build_shard_deliveries
+
+    topo = build_topology("powerlaw", 1500, seed=3, m=3)
+    n = topo.num_nodes
+    n_padded, shards = 1504, 8
+    local = n_padded // shards
+    stacked = build_shard_deliveries(topo, n_padded, shards)
+    rng = np.random.default_rng(5)
+    xs = jnp.asarray(rng.standard_normal(n_padded), jnp.float32)
+    xw = jnp.asarray(rng.standard_normal(n_padded), jnp.float32)
+    full = build_routed_delivery(topo, device=False)
+    ref_s, ref_w = full.matvec(xs[:n], xw[:n], interpret=True)
+    for k in range(shards):
+        sd = jax.tree.map(lambda x: x[k], stacked)
+        s, w = sd.matvec(xs, xw, interpret=True)
+        lo, hi = k * local, min((k + 1) * local, n)
+        np.testing.assert_array_equal(
+            np.asarray(s)[: hi - lo], np.asarray(ref_s)[lo:hi])
+        np.testing.assert_array_equal(
+            np.asarray(w)[: hi - lo], np.asarray(ref_w)[lo:hi])
+        # padding rows (last shard) receive exact zeros
+        assert np.all(np.asarray(s)[hi - lo:] == 0)
+
+
+def test_shard_plan_cache_roundtrip_bitwise(tmp_path):
+    """The sharded entries cache like the single-chip ones: a hit loads
+    bitwise the stacked tables the build produced."""
+    import jax
+
+    from gossipprotocol_tpu.ops import plancache
+
+    topo = build_topology("er", 700, seed=5, avg_degree=6.0)
+    s1, state = plancache.shard_deliveries_cached(
+        topo, 704, 4, cache_dir=str(tmp_path))
+    assert state == "miss"
+    s2, state2 = plancache.shard_deliveries_cached(
+        topo, 704, 4, cache_dir=str(tmp_path))
+    assert state2 == "hit"
+    l1, t1 = jax.tree.flatten(s1)
+    l2, t2 = jax.tree.flatten(s2)
+    assert t1 == t2
+    for a, b in zip(l1, l2):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # a different partition of the same graph is a different entry
+    _, state3 = plancache.shard_deliveries_cached(
+        topo, 704, 8, cache_dir=str(tmp_path))
+    assert state3 == "miss"
+
+
+def test_sharded_routed_engine_matches_single_chip(cpu_devices):
+    """delivery='routed' under --devices N (VERDICT r4 #5 resolved the
+    'works' way): the mesh trajectory is BITWISE the single-chip one —
+    stronger than the scatter path's ulp-level match, because each
+    shard's per-node reduce trees are the single-chip trees."""
+    from gossipprotocol_tpu import RunConfig, run_simulation
+    from gossipprotocol_tpu.parallel import run_simulation_sharded
+
+    topo = build_topology("powerlaw", 900, seed=3, m=3)
+    base = dict(algorithm="push-sum", fanout="all", predicate="global",
+                tol=1e-4, seed=11, delivery="routed", chunk_rounds=16)
+    r1 = run_simulation(topo, RunConfig(**base))
+    r8 = run_simulation_sharded(topo, RunConfig(**base), num_devices=8,
+                                backend="cpu")
+    assert r1.converged and r8.converged
+    assert r1.rounds == r8.rounds
+    np.testing.assert_array_equal(np.asarray(r1.final_state.s),
+                                  np.asarray(r8.final_state.s))
+    np.testing.assert_array_equal(np.asarray(r1.final_state.w),
+                                  np.asarray(r8.final_state.w))
