@@ -366,9 +366,21 @@ def build_routed_delivery(topo: Topology, progress=None,
     src_nodes = np.repeat(np.arange(n, dtype=np.int64), degree)
     e1_slot = node_start_pair[rank[src_nodes]] + (
         np.arange(len(indices), dtype=np.int64) - offsets[src_nodes])
-    # reverse-edge rank: position of (v, u) in v's row, via lexsort pairing
-    fwd = np.lexsort((indices, src_nodes))   # sorted (u, v) — CSR is sorted
-    rev = np.lexsort((src_nodes, indices))   # sorted (v, u)
+    # reverse-edge rank: position of (v, u) in v's row, via sort pairing.
+    # The canonical CSR is (u, v)-lexicographic already (csr_from_edges
+    # sorts every row), so the forward order is free — RECHECKED cheaply
+    # because a hand-built Topology with an unsorted row would otherwise
+    # silently pair edges with the wrong reverse slots (same invariant
+    # pattern as gossip.reverse_slot_table). The reverse order is one
+    # combined-key argsort.
+    if len(indices) and not bool(
+            (np.diff(src_nodes * np.int64(n) + indices) > 0).all()):
+        raise ValueError(
+            "routed delivery requires canonical CSR rows (sorted, "
+            "deduplicated neighbors) — build the topology via "
+            "csr_from_edges")
+    fwd = np.arange(len(indices), dtype=np.int64)
+    rev = plan_mod.argsort_pairs(indices, src_nodes, n)
     # edge (u->v) pairs with edge (v->u): the i-th entry of fwd-sorted
     # (u,v) equals the i-th entry of rev-sorted (v,u) swapped
     reverse_of = np.empty(len(indices), np.int64)

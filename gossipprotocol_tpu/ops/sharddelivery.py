@@ -176,7 +176,9 @@ def build_shard_delivery(
     nu_src = sum(cap for *_, cap in classes_src)
 
     # out-rank of each directed edge within its source's edge group
-    by_src = np.lexsort((tgt, src))
+    from gossipprotocol_tpu.ops.plan import argsort_pairs
+
+    by_src = argsort_pairs(src, tgt, n)
     src_o = src[by_src]
     grp = np.r_[0, np.flatnonzero(np.diff(src_o)) + 1]
     grp_len = np.diff(np.r_[grp, len(src_o)])
