@@ -58,6 +58,21 @@ def test_walk_hops_within_oracle_band(native_oracle):
         assert lo <= res.rounds <= hi, (res.rounds, (lo, hi))
 
 
+def test_walk_line_hops_within_oracle_band(native_oracle):
+    """Line topology — the reference's pathological case (path 2-cover,
+    Report.pdf p.2 orange) — engine hops sit in the oracle's widened
+    25-seed band there too, not just on full."""
+    topo = build_topology("line", 48)
+    oracle = [native_oracle.async_pushsum_hops(topo, seed=s, start_node=24)
+              for s in range(25)]
+    lo, hi = min(oracle) / 2, max(oracle) * 2
+    res = run_simulation(topo, RunConfig(
+        algorithm="push-sum", semantics="reference", seed=3,
+        chunk_rounds=4096))
+    assert res.converged
+    assert lo <= res.rounds <= hi, (res.rounds, (lo, hi))
+
+
 def test_walk_line_is_slower_than_parallel():
     """The walk's defining property — line push-sum is a path 2-cover
     (Report.pdf p.2 orange's erratic slowness) — versus the parallel
