@@ -68,7 +68,7 @@ def test_walk_line_hops_within_oracle_band(native_oracle):
     lo, hi = min(oracle) / 2, max(oracle) * 2
     res = run_simulation(topo, RunConfig(
         algorithm="push-sum", semantics="reference", seed=3,
-        chunk_rounds=4096))
+        seed_node=24, chunk_rounds=4096))  # start matched to the oracle
     assert res.converged
     assert lo <= res.rounds <= hi, (res.rounds, (lo, hi))
 
