@@ -132,6 +132,23 @@ def test_walk_cli_reference_population(capsys):
     assert rounds > 100  # a parallel round count here would be < 20
 
 
+@pytest.mark.parametrize("topology,n", [
+    ("line", 48), ("full", 32), ("3D", 27), ("imp3D", 27),
+])
+def test_walk_cli_reference_grid(topology, n, capsys):
+    """The reference's full 4-topology push-sum grid under --semantics
+    reference runs the walk end-to-end — including imp3D's quirk
+    topology, whose deliberate self-loops the walk traverses naturally
+    (a self-hop is a receipt, as the reference's self-send would be)."""
+    code, out, _ = run_cli([
+        str(n), topology, "push-sum", "--semantics", "reference",
+        "--seed", "2", "--chunk-rounds", "4096",
+    ], capsys)
+    assert code == 0
+    rounds = int(re.search(r"rounds: (\d+)", out).group(1))
+    assert rounds >= 2 * (n - 1)  # hop counts, not parallel rounds
+
+
 def test_walk_rejects_sharding_faults_and_trapped_seed(capsys):
     code, _, err = run_cli([
         "64", "full", "push-sum", "--semantics", "reference",
