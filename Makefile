@@ -1,0 +1,14 @@
+# Repo tooling. `make tier1` is THE gate: the exact tier-1 verify
+# command from ROADMAP.md, so builders and reviewers run the same thing
+# the driver runs. CPU-only, excludes -m slow, ~2 min.
+
+.PHONY: tier1
+
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors \
+	  -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+	  | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc

@@ -51,6 +51,7 @@ def _build_config(args, algo, fault_plan, jnp, alert_quorum=None):
         fanout=args.fanout,
         edge_chunks=args.edge_chunks,
         delivery=args.delivery,
+        routed_design=args.routed_design or "push",
         plan_cache=args.plan_cache,
         value_mode=args.value_mode,
         max_rounds=args.max_rounds,
@@ -220,6 +221,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "each shard runs a directed per-shard plan after "
                         "one all_gather — bitwise the single-chip "
                         "trajectory")
+    p.add_argument("--routed-design", choices=["pull", "push"], default=None,
+                   help="sharded routed delivery variant (requires "
+                        "--delivery routed with --devices N). 'push' "
+                        "(default): owner-computes — each shard expands "
+                        "only its owned rows and one all_to_all exchanges "
+                        "the edge shares, every table O(E/S + local_n). "
+                        "'pull': the round-5 design — all_gather the full "
+                        "state, per-shard O(n) plan_in tables; escape "
+                        "hatch for graphs the push compiler rejects")
     p.add_argument("--plan-cache", type=str, default=None, metavar="DIR",
                    help="routed-delivery plan cache directory (default "
                         "$GOSSIP_TPU_PLAN_CACHE or "
@@ -415,6 +425,14 @@ def main(argv=None) -> int:
                     "delivery='invert' is single-chip only — drop --devices "
                     "or use delivery='scatter'"
                 )
+        if args.routed_design is not None and (
+                cfg.delivery != "routed" or args.devices <= 1):
+            raise ValueError(
+                "--routed-design selects between the sharded routed "
+                "delivery variants — it needs --delivery routed AND "
+                "--devices N (got delivery=%r, devices=%d)"
+                % (cfg.delivery, args.devices)
+            )
         if cfg.delivery == "routed" and topo.implicit_full:
             raise ValueError(
                 "delivery='routed' needs an explicit edge list; the "

@@ -94,6 +94,16 @@ class RunConfig:
                                    # NOT a trajectory field: a cache hit
                                    # loads bitwise the tables the build
                                    # produces (tests/test_routing.py)
+    routed_design: str = "push"    # sharded routed delivery: "push"
+                                   # (owner-computes + all_to_all edge
+                                   # shares, O(E/S + local_n) tables) |
+                                   # "pull" (full-state all_gather +
+                                   # O(n) plan_in — the escape hatch).
+                                   # Single-chip routed runs ignore it.
+                                   # NOT a trajectory field: both designs
+                                   # are bitwise-equal to the single-chip
+                                   # routed delivery
+                                   # (tests/test_pushdelivery.py)
     value_mode: str = "scaled"     # push-sum init: "scaled" (i/N) | "index" (i)
     dtype: Any = jnp.float32
     max_rounds: int = 1_000_000
@@ -169,6 +179,8 @@ class RunConfig:
                     "delivery='routed' routes f32 lane pairs; use "
                     "delivery='scatter' for float64 runs"
                 )
+        if self.routed_design not in ("push", "pull"):
+            raise ValueError("routed_design must be 'push' or 'pull'")
         if self.delivery == "invert":
             if self.algorithm != "push-sum" or self.fanout != "one":
                 raise ValueError(

@@ -315,6 +315,130 @@ def shard_deliveries_cached(topo, n_padded: int, num_shards: int,
     return stacked, "miss"
 
 
+# ---- sharded PUSH deliveries (owner-computes + all_to_all) --------------
+
+_PUSH_PLAN_GROUPS = ("plan_in", "plan_send", "plan_recv", "plan_out")
+
+
+def push_entry_path(cache_dir: str, key: str, n_padded: int,
+                    num_shards: int) -> str:
+    # the "routedpush_" prefix keeps _evict_over_budget's
+    # startswith("routed") filter covering this family too
+    return os.path.join(
+        cache_dir,
+        f"routedpush_v{FORMAT_VERSION}_{key}_p{n_padded}x{num_shards}.npz")
+
+
+def save_push_shards(stacked, path: str) -> None:
+    """Serialize a stacked ShardPushDelivery (numpy leaves, leading
+    shard axis — what build_shard_push_deliveries returns)."""
+    arrays: dict = {}
+    meta = {
+        "format": FORMAT_VERSION,
+        "n": stacked.n, "local_n": stacked.local_n,
+        "num_shards": stacked.num_shards,
+        "nu": stacked.nu, "m_pairs": stacked.m_pairs,
+        "block_pairs": stacked.block_pairs,
+        "classes": [list(c) for c in stacked.classes],
+        "realmask_shape": list(stacked.realmask.shape),
+    }
+    for group in _PUSH_PLAN_GROUPS:
+        plans = getattr(stacked, group)
+        meta[group] = [
+            _pack_plan(f"{group}{i}", dp, arrays)
+            for i, dp in enumerate(plans)
+        ]
+    arrays["realmask_bits"] = np.packbits(
+        np.asarray(stacked.realmask).astype(bool))
+    arrays["degree"] = np.asarray(stacked.degree, np.int32)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}.npz"
+    try:
+        np.savez(tmp, __meta__=json.dumps(meta), **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_push_shards(path: str):
+    """Stacked ShardPushDelivery from a cache entry, or None."""
+    from gossipprotocol_tpu.ops.sharddelivery import ShardPushDelivery
+
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+            if meta.get("format") != FORMAT_VERSION:
+                return None
+            shape = tuple(meta["realmask_shape"])
+            count = int(np.prod(shape))
+            realmask = np.unpackbits(
+                z["realmask_bits"], count=count
+            ).astype(np.float32).reshape(shape)
+            try:
+                os.utime(path)
+            except OSError:
+                pass
+            return ShardPushDelivery(
+                n=meta["n"], local_n=meta["local_n"],
+                num_shards=meta["num_shards"],
+                nu=meta["nu"], m_pairs=meta["m_pairs"],
+                block_pairs=meta["block_pairs"],
+                classes=tuple(tuple(c) for c in meta["classes"]),
+                plan_in=tuple(_unpack_plan(f"plan_in{i}", m, z)
+                              for i, m in enumerate(meta["plan_in"])),
+                plan_send=tuple(_unpack_plan(f"plan_send{i}", m, z)
+                                for i, m in enumerate(meta["plan_send"])),
+                plan_recv=tuple(_unpack_plan(f"plan_recv{i}", m, z)
+                                for i, m in enumerate(meta["plan_recv"])),
+                plan_out=tuple(_unpack_plan(f"plan_out{i}", m, z)
+                               for i, m in enumerate(meta["plan_out"])),
+                realmask=realmask,
+                degree=z["degree"],
+            )
+    except (OSError, ValueError, KeyError, json.JSONDecodeError,
+            zipfile.BadZipFile):
+        return None
+
+
+def shard_push_deliveries_cached(topo, n_padded: int, num_shards: int,
+                                 cache_dir: str | None = None,
+                                 progress=None):
+    """Cache-aware build_shard_push_deliveries, same policy as
+    :func:`shard_deliveries_cached` (entries keyed by adjacency hash +
+    the mesh partition)."""
+    from gossipprotocol_tpu.ops.sharddelivery import (
+        build_shard_push_deliveries,
+    )
+
+    cache_dir = cache_dir or default_cache_dir()
+    if cache_dir == "none":
+        return build_shard_push_deliveries(
+            topo, n_padded, num_shards, progress=progress), "off"
+    path = push_entry_path(cache_dir, cache_key(topo), n_padded,
+                           num_shards)
+    stacked = load_push_shards(path)
+    if stacked is not None:
+        if progress:
+            progress(f"push routed delivery: plan cache hit ({path})")
+        return stacked, "hit"
+    stacked = build_shard_push_deliveries(topo, n_padded, num_shards,
+                                          progress=progress)
+    try:
+        save_push_shards(stacked, path)
+        _evict_over_budget(cache_dir, keep=path)
+        if progress:
+            progress(f"push routed delivery: plans cached ({path})")
+    except OSError as e:
+        import warnings
+
+        warnings.warn(f"push plan cache write failed ({e}); "
+                      "continuing uncached")
+    return stacked, "miss"
+
+
 def _evict_over_budget(cache_dir: str, keep: str) -> None:
     """Drop oldest entries past ``$GOSSIP_TPU_PLAN_CACHE_GB`` (default 20).
 
@@ -335,8 +459,8 @@ def _evict_over_budget(cache_dir: str, keep: str) -> None:
         return
     entries = []
     for f in listing:
-        # covers both entry families: "routed_v*" (single-chip) and
-        # "routedsh_v*" (sharded)
+        # covers every entry family: "routed_v*" (single-chip),
+        # "routedsh_v*" (sharded pull), "routedpush_v*" (sharded push)
         if not (f.startswith("routed") and f.endswith(".npz")):
             continue
         p = os.path.join(cache_dir, f)

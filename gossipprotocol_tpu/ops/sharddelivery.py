@@ -362,6 +362,495 @@ def build_shard_deliveries(topo: Topology, n_padded: int, num_shards: int,
     ])
 
 
+# ---- PUSH design: owner-computes + all_to_all edge-share exchange ------
+#
+# The pull design above must all-gather the full share vectors and its
+# plan_in tables address all n nodes — an O(n)-per-shard term that the
+# assessment (artifacts/sharded_routed_assessment.json) measures at tens
+# of GB/shard at 100M. The push design removes every O(n) term: each
+# shard expands only its OWNED rows, partitions the expanded edge shares
+# by destination shard, exchanges them with one ``all_to_all``
+# (2·E/S·4 B per shard per round — ~1.7 ms at 10M/8 on v5e ICI), and
+# reduces locally. Every table is O(E/S + local_n), asserted at build
+# time in :func:`build_shard_push_deliveries`.
+#
+# One class set serves both sides: the graph is symmetric, so the rows a
+# shard expands (out-edges) are exactly the rows it reduces (in-edges),
+# classed by their full degree — the e1 (expand output) and f (reduce
+# input) layouts coincide, and the shard's CSR slice is read twice: entry
+# (row, nbr) is the out-edge row->nbr on the expand side and the in-edge
+# nbr->row on the reduce side.
+#
+# Bitwise equality with the single-chip routed delivery holds for the
+# same reason the pull design's does: node v's incoming values land at
+# (v's class slots, in-CSR-row order) and the per-node reduce tree
+# depends only on the class c — shares are computed elementwise
+# identically, so every node sums the same f32 values through the same
+# tree. Intra-shard edges bypass the all_to_all (for geometry-local
+# graphs like line/3D they are the bulk of E and would otherwise force
+# slab capacity S·max-block = O(E)): ``plan_send`` routes e1 to the
+# concatenation [f_local | slab] — local edges straight to their f
+# slots, cross edges to their destination block — and after the
+# exchange ``plan_recv`` routes [f_local | incoming] to f, writing
+# every real f slot from exactly one source. Routing the two streams
+# as one plan keeps each plan's input and output the same scale; a
+# standalone e1->slab plan funnels a large input into a tiny output
+# and trips the radix geometry guards (measured: final merge K=7 on a
+# 500-node power law at 2 shards).
+
+
+class ShardPushDelivery(NamedTuple):  # registered below (geometry aux)
+    """One shard's push-design routed delivery: local in, local out.
+
+    ``matvec`` is collective (one ``jax.lax.all_to_all`` over
+    ``axis_name``) — it must run under ``shard_map`` on a mesh whose
+    axis size equals ``num_shards``.
+    """
+
+    n: int                        # global real nodes
+    local_n: int                  # rows this shard owns
+    num_shards: int
+    nu: int                       # capacity-padded node slots
+    m_pairs: int                  # class-layout pair slots (e1 == f)
+    block_pairs: int              # slab capacity per (src, dst) pair
+    classes: Tuple[Tuple[int, int, int, int, int], ...]
+    plan_in: Tuple[DevicePlan, ...]    # [xs_l|xw_l] -> class order
+    plan_send: Tuple[DevicePlan, ...]  # e1 -> [f_local | slab]
+    plan_recv: Tuple[DevicePlan, ...]  # [f_local | incoming] -> f
+    plan_out: Tuple[DevicePlan, ...]   # class order -> local natural
+    realmask: jax.Array           # f32 [2 * m_pairs]
+    degree: jax.Array             # int32 [local_n] (full degree)
+
+    def matvec(self, xs: jax.Array, xw: jax.Array, *, axis_name: str,
+               interpret: bool = False):
+        """(in_s, in_w)[local i] = sum over neighbors j of x[j], with
+        ``xs``/``xw`` the LOCAL row slices (no full-state input)."""
+        from gossipprotocol_tpu.ops import classops as co
+
+        flat = jnp.concatenate([xs[: self.local_n], xw[: self.local_n]])
+        cls = _apply_chain(self.plan_in, flat, interpret,
+                           take_f32=self.nu * 2)
+        segs = []
+        off = 0
+        for c, n_c, start, reg_rows, cap in self.classes:
+            node_pairs = jax.lax.dynamic_slice_in_dim(cls, 2 * off, 2 * cap)
+            if 2 * c <= 128:
+                segs.append(co.class_expand_small(node_pairs, c, interpret))
+            else:
+                segs.append(co.class_expand_big(node_pairs, c, interpret))
+            off += cap
+        e1 = jnp.concatenate(segs) * self.realmask
+        # [f_local | slab]: local edges land straight at their f slots,
+        # cross edges in their destination-shard block; every
+        # don't-care slot (block padding included) ships an exact zero
+        slab_f32 = 2 * self.num_shards * self.block_pairs
+        out = _apply_chain(self.plan_send, e1, interpret,
+                           take_f32=2 * self.m_pairs + slab_f32)
+        f_local = out[: 2 * self.m_pairs]
+        slab = out[2 * self.m_pairs:].reshape(
+            self.num_shards, 2 * self.block_pairs)
+        incoming = jax.lax.all_to_all(
+            slab, axis_name, split_axis=0, concat_axis=0, tiled=True)
+        # every real f slot reads from exactly one source: its own
+        # f_local slot (intra-shard) or its incoming block slot (cross)
+        f = _apply_chain(self.plan_recv,
+                         jnp.concatenate([f_local, incoming.reshape(-1)]),
+                         interpret, take_f32=self.m_pairs * 2)
+        ys = []
+        for c, n_c, start, reg_rows, cap in self.classes:
+            region = jax.lax.dynamic_slice_in_dim(
+                f, 2 * start, reg_rows * 128)
+            if 2 * c <= 128:
+                packed = co.class_reduce_small(region, c, interpret)
+            else:
+                packed = co.class_reduce_big(region, c, interpret)
+            ys.append(packed[: 2 * cap])
+        yf = jnp.concatenate(ys)
+        nat = _apply_chain(self.plan_out, yf, interpret,
+                           take_f32=2 * self.local_n)
+        return nat[: self.local_n], nat[self.local_n:]
+
+
+def _register_push():
+    def flatten(r):
+        return ((r.plan_in, r.plan_send, r.plan_recv,
+                 r.plan_out, r.realmask, r.degree),
+                (r.n, r.local_n, r.num_shards, r.nu, r.m_pairs,
+                 r.block_pairs, r.classes))
+
+    def unflatten(aux, children):
+        return ShardPushDelivery(*aux, *children)
+
+    jax.tree_util.register_pytree_node(ShardPushDelivery, flatten,
+                                       unflatten)
+
+
+_register_push()
+
+
+def build_shard_push_delivery(
+    topo: Topology, n_padded: int, num_shards: int, shard: int,
+    caps: dict | None = None, block_pairs: int | None = None,
+    cr_floors: dict | None = None,
+    geometry_only: bool = False,
+    progress=None,
+):
+    """Compile one shard's push-design delivery (owned rows only).
+
+    Same uniformization hooks as :func:`build_shard_delivery`:
+    ``caps`` forces per-class node capacities, ``block_pairs`` forces
+    the all_to_all block capacity, ``cr_floors`` forces per-stage run
+    capacities (``{"in"|"send"|"recv"|"out"}``), and
+    ``geometry_only=True`` returns the raw plan pairs for the cheap
+    cross-shard maxima pre-pass.
+    """
+    from gossipprotocol_tpu.ops.delivery import RoutedConfigError
+
+    if topo.implicit_full:
+        raise RoutedConfigError(
+            "push delivery needs an explicit edge list")
+    if topo.asymmetric:
+        raise RoutedConfigError(
+            "push delivery needs a symmetric simple graph")
+    n = topo.num_nodes
+    local = n_padded // num_shards
+    lo = shard * local
+    hi_real = max(lo, min(lo + local, n))
+    offsets = np.asarray(topo.offsets, np.int64)
+    indices = np.asarray(topo.indices, np.int64)
+    degree_full = np.diff(offsets)
+    degree = np.zeros(local, np.int64)
+    degree[: hi_real - lo] = degree_full[lo:hi_real]
+
+    # one class set for both sides (see the design note above)
+    cls = degree_classes(degree)
+    order, rank, _ = class_order(cls, local)
+    classes, node_start_pair, m_pairs, pos = class_layout(
+        cls[order], caps=caps)
+    nu = sum(cap for *_, cap in classes)
+
+    # the shard's CSR slice: entry j = (row[j], nbr[j]); slot[j] is BOTH
+    # the e1 slot of out-edge row->nbr and the f slot of in-edge
+    # nbr->row, because the two sides share one layout
+    nbr = indices[offsets[lo]: offsets[hi_real]]
+    row = np.repeat(np.arange(lo, hi_real, dtype=np.int64),
+                    degree_full[lo:hi_real])
+    pos_in_row = (np.arange(len(nbr), dtype=np.int64)
+                  - np.repeat(offsets[lo:hi_real] - offsets[lo],
+                              degree_full[lo:hi_real]))
+    slot = node_start_pair[rank[row - lo]] + pos_in_row
+    nbr_shard = nbr // local
+    is_local = nbr_shard == shard
+
+    realmask_pairs = np.zeros(m_pairs, bool)
+    realmask_pairs[slot] = True
+    realmask = np.repeat(realmask_pairs, 2).astype(np.float32)
+
+    from gossipprotocol_tpu.ops.plan import argsort_pairs
+
+    # ---- intra-shard edges: e1 -> f directly, no exchange ------------
+    # the local directed edge set is closed under reversal; sorting it
+    # by (row, nbr) and by (nbr, row) pairs every edge with its reverse
+    # at equal positions, and the f slot of u->v is the slot of entry
+    # (row=v, nbr=u) while its expanded value sits at the reverse
+    # entry's e1 slot
+    li = np.flatnonzero(is_local)
+    p1 = li[argsort_pairs(row[li], nbr[li], n)]
+    p2 = li[argsort_pairs(nbr[li], row[li], n)]
+
+    # ---- cross-shard edges -------------------------------------------
+    # outbound: entry as out-edge row->nbr goes to shard nbr//local;
+    # block contents canonically ordered by (target, source) = (nbr,
+    # row) — computable identically on both endpoints at build time
+    xi = np.flatnonzero(~is_local)
+    po = xi[argsort_pairs(nbr[xi], row[xi], n)]
+    d_sorted = nbr_shard[po]  # non-decreasing (shard monotone in nbr)
+    starts = np.r_[0, np.flatnonzero(np.diff(d_sorted)) + 1]
+    lens = np.diff(np.r_[starts, len(d_sorted)])
+    rank_in_block = (np.arange(len(po), dtype=np.int64)
+                     - np.repeat(starts, lens))
+    # symmetric graph: this one bincount is both the outbound and the
+    # inbound per-shard block census (entry (row, nbr) is one edge pair)
+    bmax = int(np.bincount(d_sorted, minlength=num_shards).max()) \
+        if len(xi) else 0
+    if block_pairs is None:
+        block_pairs = max(64, -(-max(bmax, 1) // 64) * 64)
+    if bmax > block_pairs:
+        raise AssertionError(
+            "forced block capacity below this shard's natural maximum")
+    slab_pairs = num_shards * block_pairs
+
+    # plan_send: e1 -> [f_local | slab] (see the design note above)
+    src_of_send = np.full(m_pairs + slab_pairs, -1, np.int64)
+    src_of_send[slot[p1]] = slot[p2]
+    src_of_send[m_pairs + d_sorted * block_pairs + rank_in_block] = \
+        slot[po]
+
+    # plan_recv: [f_local | incoming] -> f. Local-edge f slots read
+    # their own position in part 1; cross-edge f slots read their
+    # incoming block slot. The same entries read as in-edges nbr->row
+    # come from source shard nbr//local, and within a block the
+    # sender's (target, source) order is our (row, nbr) order — the
+    # CSR enumeration order — so a stable sort by source shard
+    # reproduces the sender's block layout
+    pr = xi[np.argsort(nbr_shard[xi], kind="stable")]
+    s_sorted = nbr_shard[pr]
+    starts_r = np.r_[0, np.flatnonzero(np.diff(s_sorted)) + 1]
+    lens_r = np.diff(np.r_[starts_r, len(s_sorted)])
+    rank_r = (np.arange(len(pr), dtype=np.int64)
+              - np.repeat(starts_r, lens_r))
+    src_of_recv = np.full(m_pairs, -1, np.int64)
+    src_of_recv[slot[p1]] = slot[p1]
+    src_of_recv[slot[pr]] = (m_pairs + s_sorted * block_pairs + rank_r)
+
+    if progress:
+        progress(f"push shard {shard}: {len(nbr)} owned directed edges "
+                 f"({len(xi)} cross), block {block_pairs} pairs, "
+                 f"classes {[(c, k) for c, k, *_ in classes]}")
+
+    floors = cr_floors or {}
+    src_in = np.full(2 * nu, -1, np.int64)
+    src_in[2 * pos] = order
+    src_in[2 * pos + 1] = local + order
+    plans_in = _chained_plans(src_in, m_in=2 * local, progress=progress,
+                              unit=1, cr_floors=floors.get("in"),
+                              geometry_only=geometry_only)
+    plans_send = _chained_plans(src_of_send, m_in=m_pairs,
+                                progress=progress,
+                                cr_floors=floors.get("send"),
+                                geometry_only=geometry_only)
+    plans_recv = _chained_plans(src_of_recv,
+                                m_in=m_pairs + slab_pairs,
+                                progress=progress,
+                                cr_floors=floors.get("recv"),
+                                geometry_only=geometry_only)
+    src_out = np.full(2 * local, -1, np.int64)
+    has = degree > 0
+    pos_of_row = np.full(local, -1, np.int64)
+    pos_of_row[order] = pos
+    src_out[:local][has] = 2 * pos_of_row[has]
+    src_out[local:][has] = 2 * pos_of_row[has] + 1
+    plans_out = _chained_plans(src_out, m_in=2 * nu, progress=progress,
+                               unit=1, cr_floors=floors.get("out"),
+                               geometry_only=geometry_only)
+
+    if geometry_only:
+        return {"in": plans_in, "send": plans_send,
+                "recv": plans_recv, "out": plans_out}
+
+    return ShardPushDelivery(
+        n=n, local_n=local, num_shards=num_shards, nu=nu,
+        m_pairs=m_pairs, block_pairs=block_pairs, classes=classes,
+        plan_in=tuple(device_plan(p) for p in plans_in),
+        plan_send=tuple(device_plan(p) for p in plans_send),
+        plan_recv=tuple(device_plan(p) for p in plans_recv),
+        plan_out=tuple(device_plan(p) for p in plans_out),
+        realmask=realmask,
+        degree=np.asarray(degree, np.int32),
+    )
+
+
+def assert_push_tables_linear(m_pairs: int, num_shards: int,
+                              block_pairs: int, e_max: int, local: int,
+                              n_classes: int) -> int:
+    """The build-time O(E/S + local_n) guard the push design promises.
+
+    ``e_max`` is the max per-shard owned directed edge count (== E/S on
+    a balanced partition). Class capacity padding contributes at most a
+    factor ~8 (merged-class slack) plus BLK-row alignment per class;
+    anything past a generous 16x + alignment slack means the partition
+    is pathologically skewed (e.g. one shard's edges all aimed at one
+    other shard inflating the uniform slab capacity) and the push
+    design would silently cost O(E) per shard — reject loudly instead.
+    Returns the budget (pairs) for tests to inspect.
+    """
+    from gossipprotocol_tpu.ops.classops import BLK
+    from gossipprotocol_tpu.ops.delivery import RoutedConfigError
+
+    budget = 16 * (e_max + local) + (n_classes + 1) * BLK * 64 + 64
+    for name, pairs in (("class-layout", m_pairs),
+                        ("all_to_all slab", num_shards * block_pairs)):
+        if pairs > budget:
+            raise RoutedConfigError(
+                f"push-design {name} table needs {pairs} pair slots, "
+                f"over the O(E/S + local_n) budget of {budget} (max "
+                f"shard edges {e_max}, local rows {local}): the "
+                "partition is too skewed for the push design — rerun "
+                "with --routed-design pull or --delivery scatter")
+    return budget
+
+
+def push_program_geometry(sd: ShardPushDelivery):
+    """Everything the compiled push matvec program depends on (per-shard
+    real counts n_c are advisory and may differ)."""
+    leaves, _ = jax.tree.flatten(sd)
+
+    def plan_geo(p):
+        return (p.unit, p.nt_in, p.nt_out,
+                tuple(st[:6] for st in p.stages), p.final.k)
+
+    return (sd.n, sd.local_n, sd.num_shards, sd.nu, sd.m_pairs,
+            sd.block_pairs,
+            tuple((c, start, rows, cap)
+                  for c, _, start, rows, cap in sd.classes),
+            tuple(tuple(plan_geo(p) for p in getattr(sd, g))
+                  for g in ("plan_in", "plan_send", "plan_recv",
+                            "plan_out")),
+            tuple((x.shape, str(x.dtype)) for x in leaves))
+
+
+def _build_push_shards(topo: Topology, n_padded: int, num_shards: int,
+                       progress=None) -> list:
+    """Uniformized per-shard push builds (capacity/block pre-pass +
+    cr-floors fixpoint), one :class:`ShardPushDelivery` per shard, not
+    yet stacked — exposed separately so tests can compare the shards'
+    program geometry directly."""
+    local = n_padded // num_shards
+
+    # capacity + block pre-pass: per-class node-count maxima and the
+    # cross-shard max block census (one bincount per shard, O(E) total)
+    n = topo.num_nodes
+    offsets = np.asarray(topo.offsets, np.int64)
+    indices = np.asarray(topo.indices, np.int64)
+    degree_full = np.diff(offsets)
+    caps: dict = {}
+    bmax = 0
+    e_max = 0
+    for k in range(num_shards):
+        lo = k * local
+        hi_real = max(lo, min(lo + local, n))
+        deg = degree_full[lo:hi_real]
+        cls = degree_classes(deg)
+        c_vals, counts = np.unique(cls[cls > 0], return_counts=True)
+        for c, cnt in zip(c_vals, counts):
+            caps[int(c)] = max(caps.get(int(c), 0), int(cnt))
+        nbr = indices[offsets[lo]: offsets[hi_real]]
+        e_max = max(e_max, len(nbr))
+        nbr_shard = nbr // local
+        cross = nbr_shard[nbr_shard != k]
+        if len(cross):
+            bmax = max(bmax, int(np.bincount(
+                cross, minlength=num_shards).max()))
+    block_pairs = max(64, -(-max(bmax, 1) // 64) * 64)
+
+    # the promised build-time size guard, before any tile routing
+    cls_sorted = np.repeat(
+        np.array(sorted(caps), np.int64),
+        np.array([caps[c] for c in sorted(caps)], np.int64),
+    ) if caps else np.zeros(0, np.int64)
+    _, _, m_pairs_u, _ = class_layout(cls_sorted, caps=caps)
+    assert_push_tables_linear(m_pairs_u, num_shards, block_pairs,
+                              e_max, local, len(caps))
+
+    # cr-floors fixpoint, same reasoning as build_shard_deliveries
+    groups = ("in", "send", "recv", "out")
+    cr_floors = None
+    while True:
+        cr_max: dict = {}
+        for k in range(num_shards):
+            geo = build_shard_push_delivery(
+                topo, n_padded, num_shards, k, caps=caps,
+                block_pairs=block_pairs, cr_floors=cr_floors,
+                geometry_only=True)
+            for group, pair in geo.items():
+                for pi, plan in enumerate(pair):
+                    crs = tuple(st.cr for st in plan.stages)
+                    key = (group, pi)
+                    prev = cr_max.get(key, (0,) * len(crs))
+                    if len(prev) != len(crs):
+                        raise AssertionError(
+                            "per-shard stage counts diverged (uniform m "
+                            "should fix them — compiler bug)")
+                    cr_max[key] = tuple(
+                        max(a, b) for a, b in zip(prev, crs))
+        floors_now = {
+            g: (cr_max[(g, 0)], cr_max[(g, 1)]) for g in groups
+        }
+        if floors_now == cr_floors:
+            break
+        cr_floors = floors_now
+
+    shards = []
+    for k in range(num_shards):
+        shards.append(build_shard_push_delivery(
+            topo, n_padded, num_shards, k, caps=caps,
+            block_pairs=block_pairs, cr_floors=cr_floors,
+            progress=progress))
+    return shards
+
+
+def build_shard_push_deliveries(topo: Topology, n_padded: int,
+                                num_shards: int,
+                                progress=None) -> ShardPushDelivery:
+    """All shards' push deliveries, geometry-uniform, leaves stacked on
+    a leading shard axis (same layout contract as
+    :func:`build_shard_deliveries`). Unlike the pull builder this does
+    NO whole-graph work per shard — the pre-pass and each shard's build
+    touch only that shard's CSR slice."""
+    shards = _build_push_shards(topo, n_padded, num_shards,
+                                progress=progress)
+
+    g0 = push_program_geometry(shards[0])
+    for k, sd in enumerate(shards[1:], 1):
+        if push_program_geometry(sd) != g0:
+            raise AssertionError(
+                f"shard {k} push geometry diverged despite forced "
+                "caps/block — capacity uniformization bug")
+    leaves0, treedef0 = jax.tree.flatten(shards[0])
+    all_leaves = [jax.tree.flatten(sd)[0] for sd in shards]
+    return treedef0.unflatten([
+        np.stack([lv[i] for lv in all_leaves])
+        for i in range(len(leaves0))
+    ])
+
+
+def pushsum_diffusion_round_routed_push(
+    state,
+    shard_rd: ShardPushDelivery,  # this device's slice (leading axis 1)
+    base_key: jax.Array,
+    *,
+    n: int,
+    eps: float = 1e-10,
+    streak_target: int = 3,
+    predicate: str = "delta",
+    tol: float = 1e-4,
+    all_alive: bool = False,
+    interpret: bool = False,
+    all_sum,
+    axis_name: str,
+):
+    """Sharded fanout-all round, PUSH design: expand owned rows, one
+    ``all_to_all`` of cross-shard edge shares (2·E/S·4 B per shard — no
+    full-state ``all_gather`` anywhere in the round), reduce locally.
+    Mathematics and legality identical to the single-chip
+    :func:`~gossipprotocol_tpu.protocols.diffusion.
+    pushsum_diffusion_round_routed`; the trajectory is bitwise equal to
+    it (same per-node reduce trees over the same f32 values).
+    """
+    from gossipprotocol_tpu.protocols.pushsum import finish_pushsum_round
+
+    del base_key  # deterministic: fanout-all draws nothing
+    rd = jax.tree.map(lambda x: x[0], shard_rd)  # drop the shard axis
+    dt = state.s.dtype
+    deg = rd.degree.astype(dt)
+    inv = 1 / (deg + 1)
+    share_s = state.s * inv
+    share_w = state.w * inv
+    if not all_alive:
+        share_s = jnp.where(state.alive, share_s, 0)
+        share_w = jnp.where(state.alive, share_w, 0)
+    in_s, in_w = rd.matvec(share_s, share_w, axis_name=axis_name,
+                           interpret=interpret)
+    sent_s = share_s * deg
+    sent_w = share_w * deg
+    return finish_pushsum_round(
+        state, state.s - sent_s + in_s, state.w - sent_w + in_w,
+        received=in_w > 0, eps=eps, streak_target=streak_target,
+        reference_semantics=False, predicate=predicate, tol=tol,
+        all_sum=all_sum, all_alive=all_alive,
+    )
+
+
 def pushsum_diffusion_round_routed_sharded(
     state,
     shard_rd: ShardRoutedDelivery,  # this device's slice (leading axis 1)

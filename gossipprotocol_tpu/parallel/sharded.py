@@ -70,7 +70,17 @@ from gossipprotocol_tpu.topology.base import Topology
 try:  # jax >= 0.6 exposes shard_map at top level
     shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    import inspect
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if "check_vma" in inspect.signature(_shard_map).parameters:
+        shard_map = _shard_map
+    else:
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+            # pre-0.6 jax spells the replication-check flag check_rep
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
 
 
 def _sharded_core(
@@ -102,19 +112,25 @@ def _sharded_core(
         )
     if cfg.fanout == "all":
         if cfg.delivery == "routed":
-            # Sharded-routed delivery (the design measured in
-            # artifacts/sharded_routed_assessment.json): per-shard
-            # directed plans with capacities forced to cross-shard
-            # maxima (the shard_map single-program constraint — measured
-            # <1 % apart on iid shards), one all_gather of the share
-            # vectors per round (2·n·4 B — ~1.7 ms at 10M against the
-            # 5.8 s scatter round the routed kernels displace).
+            # Sharded-routed delivery (the designs measured in
+            # artifacts/sharded_routed_assessment.json), both with
+            # per-shard plans whose capacities are forced to cross-shard
+            # maxima (the shard_map single-program constraint). Default
+            # "push": each shard expands only its OWNED rows and one
+            # all_to_all moves the cross-shard edge shares (2·E/S·4 B
+            # per shard per round, all tables O(E/S + local_n) — the
+            # design that fits 100M on a v5e-8). Escape hatch "pull":
+            # all_gather the full share vectors (2·n·4 B) into O(n)
+            # per-shard plan_in tables.
             from gossipprotocol_tpu.ops.sharddelivery import (
+                pushsum_diffusion_round_routed_push,
                 pushsum_diffusion_round_routed_sharded,
             )
 
             return partial(
-                pushsum_diffusion_round_routed_sharded,
+                pushsum_diffusion_round_routed_push
+                if cfg.routed_design == "push"
+                else pushsum_diffusion_round_routed_sharded,
                 n=n,
                 eps=cfg.eps,
                 streak_target=cfg.streak_target,
@@ -350,10 +366,14 @@ def make_sharded_chunk_runner(
 
     specs = _state_specs(state0)
     if routed:
-        from gossipprotocol_tpu.ops.plancache import shard_deliveries_cached
+        from gossipprotocol_tpu.ops import plancache
 
-        nbrs, _ = shard_deliveries_cached(
-            topo, n_padded, num_shards, cache_dir=cfg.plan_cache)
+        if cfg.routed_design == "push":
+            nbrs, _ = plancache.shard_push_deliveries_cached(
+                topo, n_padded, num_shards, cache_dir=cfg.plan_cache)
+        else:
+            nbrs, _ = plancache.shard_deliveries_cached(
+                topo, n_padded, num_shards, cache_dir=cfg.plan_cache)
         nbrs_sharded = True  # leading shard axis splits over the mesh
     elif is_pushsum and cfg.fanout == "all":
         # every leaf of the edge pytree is built as equal per-device

@@ -65,6 +65,21 @@ def test_invalid_topology_errors_loudly(capsys):
     assert "unknown topology" in err
 
 
+def test_routed_design_requires_sharded_routed(capsys):
+    # --routed-design only selects between SHARDED routed variants;
+    # anywhere else it is a loud input error, not a silent no-op
+    code, _, err = run_cli(
+        ["64", "imp3D", "push-sum", "--fanout", "all",
+         "--routed-design", "push"], capsys)
+    assert code == 2
+    assert "--routed-design" in err
+    code, _, err = run_cli(
+        ["64", "imp3D", "push-sum", "--fanout", "all", "--delivery",
+         "routed", "--routed-design", "pull"], capsys)
+    assert code == 2
+    assert "--devices" in err
+
+
 def test_cube_rounding_note(capsys):
     code, out, _ = run_cli(["28", "3D", "gossip", "--seed", "0"], capsys)
     assert code == 0
