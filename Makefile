@@ -2,6 +2,10 @@
 # command from ROADMAP.md, so builders and reviewers run the same thing
 # the driver runs. CPU-only, excludes -m slow, ~2 min.
 
+# the recipe uses `set -o pipefail` and $${PIPESTATUS[0]}, both bashisms —
+# make's default /bin/sh is dash on Debian-family images and dies on them
+SHELL := /bin/bash
+
 .PHONY: tier1
 
 tier1:

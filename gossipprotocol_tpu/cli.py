@@ -18,7 +18,10 @@ Beyond the reference (north-star flags, BASELINE.json): ``--backend``,
 ``--fanout`` (diffusion push-sum), ``--delivery`` (scatter vs gather
 inversion), ``--metrics-out``, ``--checkpoint-dir``, ``--resume``,
 ``--auto-resume`` (elastic recovery), ``--compile-cache``,
-``--fail-fraction/--fail-round``, ``--devices`` (multi-chip sharding),
+``--fail-fraction/--fail-round``, ``--revive-round`` (churn),
+``--drop-prob/--drop-window`` (mass-conserving message loss),
+``--fault-plan`` (declarative JSON fault schedule),
+``--devices`` (multi-chip sharding),
 ``--ws-k/--ws-beta`` (small-world knobs), ``--profile-dir``. Invalid
 input errors loudly — the reference silently
 no-ops on unknown topologies (``Program.fs:279``) and prints "option
@@ -31,7 +34,25 @@ import argparse
 import os
 import sys
 
-def _build_config(args, algo, fault_plan, jnp, alert_quorum=None):
+def _unit_fraction(s: str) -> float:
+    """argparse type for probabilities/fractions in [0, 1).
+
+    Range errors surface as argparse's own usage message + exit 2 —
+    never a ValueError traceback from deep inside the fault machinery.
+    """
+    try:
+        v = float(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"{s!r} is not a number")
+    if not 0.0 <= v < 1.0:
+        raise argparse.ArgumentTypeError(
+            f"{v!r} is out of range — must be in [0.0, 1.0) "
+            "(1.0 would kill/drop everything, which nothing survives)"
+        )
+    return v
+
+
+def _build_config(args, algo, fault_schedule, jnp, alert_quorum=None):
     """argv -> RunConfig; raises ValueError on invalid combinations
     (caught by main and reported as exit 2, the bad-input contract)."""
     from gossipprotocol_tpu.engine import RunConfig
@@ -59,7 +80,7 @@ def _build_config(args, algo, fault_plan, jnp, alert_quorum=None):
         seed_node=args.seed_node,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
-        fault_plan=fault_plan,
+        fault_schedule=fault_schedule,
     )
 
 
@@ -291,10 +312,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "mesh init)")
     p.add_argument("--restarted", action="store_true",
                    help=argparse.SUPPRESS)  # set by recovery re-execs only
-    p.add_argument("--fail-fraction", type=float, default=0.0,
-                   help="fault injection: kill this fraction of nodes")
+    p.add_argument("--fail-fraction", type=_unit_fraction, default=0.0,
+                   help="fault injection: kill this fraction of nodes "
+                        "(in [0, 1))")
     p.add_argument("--fail-round", type=int, default=0,
                    help="round at which the failures strike")
+    p.add_argument("--revive-round", type=int, default=None, metavar="R",
+                   help="churn: the --fail-fraction victims rejoin at round "
+                        "R with fresh-born state (requires --fail-fraction; "
+                        "R must be after --fail-round). Rejoiners count "
+                        "toward convergence only once reattached to the "
+                        "majority component")
+    p.add_argument("--drop-prob", type=_unit_fraction, default=0.0,
+                   help="message loss: per-send Bernoulli drop probability "
+                        "in [0, 1). Mass-conserving for push-sum (a dropped "
+                        "send keeps its (s,w) share at the sender), so "
+                        "sum(s)/sum(w) == mean survives any loss rate")
+    p.add_argument("--drop-window", type=int, nargs=2, default=None,
+                   metavar=("START", "STOP"),
+                   help="restrict --drop-prob to rounds [START, STOP) "
+                        "(default: the whole run)")
+    p.add_argument("--fault-plan", type=str, default=None, metavar="FILE",
+                   help="declarative fault schedule (JSON): "
+                        '{"kill": [{"round": R, "ids": [...]} | '
+                        '{"round": R, "fraction": F, "seed": S}], '
+                        '"revive": [{"round": R, "ids": [...]}], '
+                        '"loss": [{"start": A, "stop": B, "prob": P}]}. '
+                        "Merged with the --fail-*/--revive-*/--drop-* sugar")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="emit a jax.profiler trace here")
     p.add_argument("--compile-cache", type=str,
@@ -401,18 +445,28 @@ def main(argv=None) -> int:
               f"{float(deg.mean()):.2f}/{int(deg.max())}")
         return 0
 
-    fault_plan = None
-    if args.fail_fraction > 0:
-        fault_plan = faults.random_fault_plan(
-            topo.num_nodes, args.fail_fraction, args.fail_round, seed=args.seed
+    try:
+        schedule = faults.build_schedule(
+            topo.num_nodes,
+            plan_file=args.fault_plan,
+            fail_fraction=args.fail_fraction,
+            fail_round=args.fail_round,
+            revive_round=args.revive_round,
+            drop_prob=args.drop_prob,
+            drop_window=tuple(args.drop_window) if args.drop_window else None,
+            seed=args.seed,
+            max_rounds=args.max_rounds,
         )
+    except (ValueError, OSError) as e:
+        print(f"fault schedule invalid: {e}", file=sys.stderr)
+        return 2
 
     import dataclasses
 
     import jax.numpy as jnp
 
     try:
-        cfg = _build_config(args, algo, fault_plan, jnp,
+        cfg = _build_config(args, algo, schedule, jnp,
                             alert_quorum=alert_quorum)
         if cfg.delivery == "invert":
             # surface the engine's build-time preconditions as clean CLI

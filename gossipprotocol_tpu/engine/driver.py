@@ -116,7 +116,22 @@ class RunConfig:
     metrics_callback: Optional[Callable[[dict], None]] = None
     checkpoint_every: int = 0      # chunks between checkpoints; 0 = off
     checkpoint_dir: Optional[str] = None
-    fault_plan: Optional[dict] = None  # {round:int -> node_ids} injected kills
+    fault_plan: Optional[dict] = None  # legacy {round:int -> node_ids}
+                                   # kill sugar; merges into the schedule
+    fault_schedule: Optional[Any] = None  # faults.FaultSchedule: timed
+                                   # kill/revive strikes + link-loss
+                                   # windows (utils/faults.py)
+
+    @property
+    def schedule(self):
+        """The effective :class:`~gossipprotocol_tpu.utils.faults.
+        FaultSchedule` — ``fault_schedule`` with the legacy ``fault_plan``
+        kills merged in. Always a schedule object (possibly empty), so
+        call sites test ``sched.has_strikes`` / ``sched.has_loss``
+        instead of juggling two optional fields."""
+        from gossipprotocol_tpu.utils import faults
+
+        return faults.as_schedule(self.fault_schedule, self.fault_plan)
 
     def __post_init__(self):
         if self.algorithm not in ALGORITHMS:
@@ -160,6 +175,7 @@ class RunConfig:
         if self.delivery not in ("scatter", "invert", "routed"):
             raise ValueError("delivery must be 'scatter', 'invert', or "
                              "'routed'")
+        sched = self.schedule.validate()  # structural check, loud + early
         if self.delivery == "routed":
             if self.algorithm != "push-sum" or self.fanout != "all":
                 raise ValueError(
@@ -168,11 +184,16 @@ class RunConfig:
                     "plan compiles; single-target draws fresh targets "
                     "every round — see README 'Performance')"
                 )
-            if self.fault_plan:
+            # kill/revive strikes are fine: the driver's kill_disconnected
+            # keeps the dead set well-defined and the routed round's
+            # live-degree general path (diffusion.py) stays exact under
+            # any dead set. Loss is not: the plan's pair tables are
+            # compiled once and cannot thread a fresh per-edge mask.
+            if sched.has_loss:
                 raise ValueError(
-                    "delivery='routed' is exact only while the dead set "
-                    "is component-closed (dead senders ship zero mass); "
-                    "drop the fault plan or use delivery='scatter'"
+                    "delivery='routed' compiles a static routing plan and "
+                    "cannot apply per-edge drop masks through it; use "
+                    "delivery='scatter' for loss windows"
                 )
             if jnp.dtype(self.dtype) != jnp.float32:
                 raise ValueError(
@@ -188,12 +209,12 @@ class RunConfig:
                     "only (gossip picks its inverted delivery automatically; "
                     "diffusion walks every edge and has nothing to invert)"
                 )
-            if self.fault_plan:
+            if sched:
                 raise ValueError(
                     "delivery='invert' is exact only while no node can die "
-                    "mid-run (receivers recompute senders' draws without "
-                    "checking target liveness); drop the fault plan or use "
-                    "delivery='scatter'"
+                    "mid-run and every send lands (receivers recompute "
+                    "senders' draws without checking liveness or loss); "
+                    "drop the fault schedule or use delivery='scatter'"
                 )
 
     def resolve_chunk_rounds(
@@ -340,13 +361,19 @@ def build_protocol(
     n = topo.num_nodes
     rows = num_rows or n
     alive0 = initial_alive(topo)
+    sched = cfg.schedule
+    # only aliveness-*changing* events (kills/revives) disable the static
+    # liveness fast paths; loss windows drop messages without ever
+    # touching the alive mask, so a drop-only schedule keeps both flags
+    strikes = sched.has_strikes
+    loss_windows = sched.static_loss_windows()
     all_alive = (
-        allow_all_alive and not cfg.fault_plan and alive0 is None and rows == n
+        allow_all_alive and not strikes and alive0 is None and rows == n
     )
     # birth exclusions are whole components, so an alive node's neighbors
     # are alive: the target-liveness gather can go as long as no fault
-    # plan (or resumed dead set) can make the dead set component-open
-    targets_alive = allow_all_alive and not cfg.fault_plan
+    # strike (or resumed dead set) can make the dead set component-open
+    targets_alive = allow_all_alive and not strikes
     if cfg.algorithm == "gossip":
         if cfg.seed_node is not None:
             seed_node = cfg.seed_node  # explicit: honored even if dead
@@ -360,6 +387,7 @@ def build_protocol(
         core = partial(
             gossip_round, n=n, threshold=threshold, keep_alive=keep_alive,
             all_alive=all_alive, inverted=gossip_inversion_enabled(topo, cfg),
+            loss_windows=loss_windows,
         )
         done_fn = gossip_done
         extra_stats = lambda s: {  # noqa: E731
@@ -380,15 +408,12 @@ def build_protocol(
                 pushsum_diffusion_round_routed,
             )
 
-            if cfg.delivery == "routed" and not targets_alive:
-                from gossipprotocol_tpu.ops.delivery import (
-                    RoutedConfigError,
-                )
-
-                raise RoutedConfigError(
-                    "delivery='routed' is exact only while the dead set "
-                    "is component-closed (no fault plan, no resumed "
-                    "arbitrary dead set) — use delivery='scatter'"
+            if loss_windows and topo.implicit_full:
+                raise ValueError(
+                    "per-edge loss windows need an explicit edge list; "
+                    "the implicit complete graph's diffusion is two "
+                    "reductions with no edges to mask — materialize the "
+                    "topology or drop the loss windows"
                 )
             round_fn = (pushsum_diffusion_round_routed
                         if cfg.delivery == "routed"
@@ -403,6 +428,10 @@ def build_protocol(
                 all_alive=all_alive,
                 targets_alive=targets_alive,
             )
+            if cfg.delivery != "routed":
+                # routed runs never carry loss (RunConfig rejects it); the
+                # scatter round threads the drop windows through delivery
+                core = partial(core, loss_windows=loss_windows)
             if cfg.delivery != "routed" and cfg.edge_chunks > 1:
                 core = partial(core, edge_chunks=cfg.edge_chunks)
             if cfg.delivery == "routed":
@@ -435,11 +464,12 @@ def build_protocol(
                     "single-chip (the reference is single-process, "
                     "Program.fs:36)"
                 )
-            if cfg.fault_plan:
+            if sched:
                 raise ValueError(
-                    "semantics='reference' push-sum cannot take faults: "
-                    "killing the token holder hangs the walk exactly as "
-                    "an actor crash would hang the reference (SURVEY §5.3)"
+                    "semantics='reference' push-sum cannot take faults or "
+                    "loss: killing the token holder — or dropping the one "
+                    "in-flight message — hangs the walk exactly as an "
+                    "actor crash would hang the reference (SURVEY §5.3)"
                 )
             if cfg.delivery != "scatter":
                 raise ValueError(
@@ -490,6 +520,7 @@ def build_protocol(
                 all_alive=all_alive,
                 targets_alive=targets_alive,
                 delivery=cfg.delivery,
+                loss_windows=loss_windows,
             )
         done_fn = pushsum_done
         extra_stats = None
@@ -687,6 +718,54 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None):
     return jax.jit(chunk, donate_argnums=0)
 
 
+def revive_rows(state, ids, cfg: RunConfig, num_nodes: int):
+    """Reset rows ``ids`` to fresh-born state — a crashed process
+    restarting from its initial value, not a resurrected one.
+
+    Runs on device via ``.at[ids].set`` between chunks — never through a
+    host round-trip. A numpy buffer zero-copy ``device_put`` into a field
+    that the next chunk *donates* lets XLA alias externally-owned memory,
+    and the eventual host fetch can then read one field's bytes through
+    another field's view (observed on CPU as ``w == s``). Gossip rows
+    drop to zero hearings; push-sum rows get their init ``(s, w)`` back —
+    the values are precomputed in numpy in the state dtype exactly as
+    :func:`~gossipprotocol_tpu.protocols.state.pushsum_init` computes
+    them (same IEEE division), so a revived trajectory is bitwise
+    identical single-chip vs sharded. The node's stranded pre-death mass
+    is discarded with the overwrite (it was already excluded from every
+    healthy-mean computation while dead). Callers flip ``alive``
+    separately — this touches only protocol state.
+    """
+    import jax.numpy as jnp
+
+    ids = np.asarray(ids, dtype=np.int64)
+    idx = jnp.asarray(ids, dtype=jnp.int32)
+
+    def put(field, values):
+        out = field.at[idx].set(values)
+        if out.sharding != field.sharding:  # compiled step expects layout
+            out = jax.device_put(out, field.sharding)
+        return out
+
+    if hasattr(state, "counts"):  # GossipState
+        return state._replace(
+            counts=put(state.counts, 0),
+            converged=put(state.converged, False),
+        )
+    dt = np.dtype(state.s.dtype)
+    vals_np = (ids.astype(dt) / dt.type(num_nodes)
+               if cfg.value_mode == "scaled" else ids.astype(dt))
+    vals = jnp.asarray(vals_np)
+    streak0 = 1 if cfg.semantics == "reference" else 0
+    return state._replace(
+        s=put(state.s, vals),
+        w=put(state.w, 1),
+        ratio=put(state.ratio, vals),  # w == 1, so ratio == s exactly
+        streak=put(state.streak, streak0),
+        converged=put(state.converged, False),
+    )
+
+
 def _drive(
     topo: Topology,
     cfg: RunConfig,
@@ -704,8 +783,13 @@ def _drive(
     (checkpoints, the returned final state).
     """
     from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
+    from gossipprotocol_tpu.utils import faults as faults_mod
 
-    fault_plan = {int(k): v for k, v in (cfg.fault_plan or {}).items()}
+    sched = cfg.schedule
+    kills = {r: np.asarray(v, dtype=np.int64)
+             for r, v in sched.kills.items()}
+    revives = {r: np.asarray(v, dtype=np.int64)
+               for r, v in sched.revives.items()}
     chunk_rounds = cfg.resolve_chunk_rounds(
         topo.num_nodes,
         None if topo.implicit_full else int(topo.indices.size),
@@ -714,7 +798,15 @@ def _drive(
     checkpoints: List[str] = []
     chunk_i = 0
     underflow_warned = False
-    cur_round = 0
+    # a checkpoint taken at round C reflects every event with r < C
+    # (events fire at loop top for r <= cur_round; chunks stop exactly at
+    # event rounds; checkpoints are written post-chunk) but never r == C.
+    # On resume, prune exactly the strictly-past events: re-firing a kill
+    # could re-kill a node revived since, and a revive reset is not
+    # idempotent (it would wipe mass the node has mixed in since rejoining)
+    cur_round = int(np.asarray(jax.device_get(state.round)))
+    kills = {r: v for r, v in kills.items() if r >= cur_round}
+    revives = {r: v for r, v in revives.items() if r >= cur_round}
     done = False
     checkpointing = bool(cfg.checkpoint_every and cfg.checkpoint_dir)
     # once per run, not per checkpoint (crc over the CSR)
@@ -724,31 +816,55 @@ def _drive(
     while True:
         if cur_round >= cfg.max_rounds:
             break
-        # fault injection (SURVEY.md §5.3): strike everything due; the
-        # round_limit below guarantees we stop exactly at the next
-        # scheduled fault so none can be skipped
-        due = [r for r in fault_plan if r <= cur_round]
-        if due:
-            from gossipprotocol_tpu.utils import faults as faults_mod
-
+        # fault events (SURVEY.md §5.3): strike everything due — several
+        # rounds' worth after a resume lands mid-schedule — in round
+        # order, kills before revives within the batch; the round_limit
+        # below guarantees we stop exactly at the next scheduled event so
+        # none can be skipped
+        due_k = sorted(r for r in kills if r <= cur_round)
+        due_r = sorted(r for r in revives if r <= cur_round)
+        if due_k or due_r:
             alive_host = np.array(ckpt_mod.fetch_host(state.alive))  # writable copy
-            for r in due:
-                ids = np.asarray(fault_plan.pop(r), dtype=np.int64)
-                alive_host[ids] = False
+            before = alive_host.copy()
+            for r in due_k:
+                alive_host[kills.pop(r)] = False
+            for r in due_r:
+                alive_host[revives.pop(r)] = True
             # unreachable-from-the-majority == failed: stranded survivors
-            # and fault-split minority components would hang the
-            # predicate forever (majority-partition semantics)
+            # and fault-split minority components would hang the predicate
+            # forever (majority-partition semantics). Re-run after revives
+            # too: a returning node counts only once it is reattached to
+            # the majority component — otherwise it stays dead (and keeps
+            # its scheduled id; a later revive can still reattach it).
             alive_host[: topo.num_nodes] = faults_mod.kill_disconnected(
                 topo, alive_host[: topo.num_nodes]
             )
-            # placed back with the original sharding — the compiled step
-            # expects its input layout unchanged
-            state = state._replace(
-                alive=jax.device_put(alive_host, state.alive.sharding)
-            )
+            alive_host[topo.num_nodes:] = False  # padding rows never live
+            # nodes that actually (re)joined — revive ids that survived
+            # the majority rule — restart from fresh-born state
+            reborn = np.flatnonzero(alive_host & ~before)
+            if reborn.size:
+                state = revive_rows(state, reborn, cfg, topo.num_nodes)
+            # apply the alive diff on device (scatter), keeping the buffer
+            # XLA-owned — a zero-copy device_put of the numpy array would
+            # feed externally-owned memory into the donating step
+            import jax.numpy as jnp
 
-        next_fault = min(fault_plan, default=cfg.max_rounds)
-        round_limit = min(cur_round + chunk_rounds, cfg.max_rounds, next_fault)
+            newly_dead = np.flatnonzero(before & ~alive_host)
+            alive_dev = state.alive
+            if newly_dead.size:
+                alive_dev = alive_dev.at[
+                    jnp.asarray(newly_dead, jnp.int32)].set(False)
+            if reborn.size:
+                alive_dev = alive_dev.at[
+                    jnp.asarray(reborn, jnp.int32)].set(True)
+            if alive_dev.sharding != state.alive.sharding:
+                # the compiled step expects its input layout unchanged
+                alive_dev = jax.device_put(alive_dev, state.alive.sharding)
+            state = state._replace(alive=alive_dev)
+
+        next_event = min([*kills, *revives], default=cfg.max_rounds)
+        round_limit = min(cur_round + chunk_rounds, cfg.max_rounds, next_event)
 
         state, stats = step(state, round_limit)
         chunk_i += 1
@@ -801,7 +917,13 @@ def _drive(
         compile_ms=compile_ms,
         num_nodes=topo.num_nodes,
         algorithm=cfg.algorithm,
-        final_state=ckpt_mod.fetch_host(trim(state)),
+        # owned copies, not device_get's zero-copy views: on CPU those
+        # alias XLA buffers from the donation chain, and once `state` is
+        # collected the arena memory is recycled by later runs — a
+        # returned result must never change value after the fact
+        final_state=jax.tree.map(
+            np.array, ckpt_mod.fetch_host(trim(state))
+        ),
         metrics=metrics,
         checkpoints=checkpoints,
     )

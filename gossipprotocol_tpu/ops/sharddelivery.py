@@ -815,6 +815,7 @@ def pushsum_diffusion_round_routed_push(
     predicate: str = "delta",
     tol: float = 1e-4,
     all_alive: bool = False,
+    targets_alive: bool = True,
     interpret: bool = False,
     all_sum,
     axis_name: str,
@@ -825,7 +826,10 @@ def pushsum_diffusion_round_routed_push(
     Mathematics and legality identical to the single-chip
     :func:`~gossipprotocol_tpu.protocols.diffusion.
     pushsum_diffusion_round_routed`; the trajectory is bitwise equal to
-    it (same per-node reduce trees over the same f32 values).
+    it (same per-node reduce trees over the same f32 values) — including
+    the general-dead-set path (``targets_alive=False``), whose extra
+    ``matvec(alive, alive)`` live-degree pass runs the identical
+    exchange, so fault strikes stay exact under any device count.
     """
     from gossipprotocol_tpu.protocols.pushsum import finish_pushsum_round
 
@@ -841,8 +845,17 @@ def pushsum_diffusion_round_routed_push(
         share_w = jnp.where(state.alive, share_w, 0)
     in_s, in_w = rd.matvec(share_s, share_w, axis_name=axis_name,
                            interpret=interpret)
-    sent_s = share_s * deg
-    sent_w = share_w * deg
+    if all_alive or targets_alive:
+        sent_s = share_s * deg
+        sent_w = share_w * deg
+    else:
+        alive_f = state.alive.astype(dt)
+        live_deg, _ = rd.matvec(alive_f, alive_f, axis_name=axis_name,
+                                interpret=interpret)
+        in_s = jnp.where(state.alive, in_s, 0)
+        in_w = jnp.where(state.alive, in_w, 0)
+        sent_s = share_s * live_deg
+        sent_w = share_w * live_deg
     return finish_pushsum_round(
         state, state.s - sent_s + in_s, state.w - sent_w + in_w,
         received=in_w > 0, eps=eps, streak_target=streak_target,
@@ -862,6 +875,7 @@ def pushsum_diffusion_round_routed_sharded(
     predicate: str = "delta",
     tol: float = 1e-4,
     all_alive: bool = False,
+    targets_alive: bool = True,
     interpret: bool = False,
     all_sum,
     axis_name: str,
@@ -872,7 +886,8 @@ def pushsum_diffusion_round_routed_sharded(
     shard's directed plan delivers its own rows. Mathematics and
     legality identical to the single-chip
     :func:`~gossipprotocol_tpu.protocols.diffusion.
-    pushsum_diffusion_round_routed`.
+    pushsum_diffusion_round_routed`, including the general-dead-set
+    live-degree path (``targets_alive=False``).
     """
     from gossipprotocol_tpu.protocols.pushsum import finish_pushsum_round
 
@@ -889,8 +904,17 @@ def pushsum_diffusion_round_routed_sharded(
     fs = jax.lax.all_gather(share_s, axis_name, tiled=True)
     fw = jax.lax.all_gather(share_w, axis_name, tiled=True)
     in_s, in_w = rd.matvec(fs, fw, interpret=interpret)
-    sent_s = share_s * deg
-    sent_w = share_w * deg
+    if all_alive or targets_alive:
+        sent_s = share_s * deg
+        sent_w = share_w * deg
+    else:
+        fa = jax.lax.all_gather(state.alive.astype(dt), axis_name,
+                                tiled=True)
+        live_deg, _ = rd.matvec(fa, fa, interpret=interpret)
+        in_s = jnp.where(state.alive, in_s, 0)
+        in_w = jnp.where(state.alive, in_w, 0)
+        sent_s = share_s * live_deg
+        sent_w = share_w * live_deg
     return finish_pushsum_round(
         state, state.s - sent_s + in_s, state.w - sent_w + in_w,
         received=in_w > 0, eps=eps, streak_target=streak_target,

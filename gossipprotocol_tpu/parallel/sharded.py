@@ -97,6 +97,9 @@ def _sharded_core(
     the interpreter everywhere else (the CPU test mesh included)."""
     ref = cfg.semantics == "reference"
     n = topo.num_nodes
+    # drop masks key on global ids, so the loss windows thread through the
+    # sharded cores unchanged — same trajectories as single-chip
+    loss_windows = cfg.schedule.static_loss_windows()
     all_sum = lambda x: jax.lax.psum(jnp.sum(x), NODES_AXIS)  # noqa: E731
     if cfg.algorithm == "gossip":
         from gossipprotocol_tpu.engine.driver import gossip_inversion_enabled
@@ -109,6 +112,7 @@ def _sharded_core(
             all_alive=all_alive,
             inverted=gossip_inversion_enabled(topo, cfg),
             all_sum=all_sum,
+            loss_windows=loss_windows,
         )
     if cfg.fanout == "all":
         if cfg.delivery == "routed":
@@ -138,6 +142,7 @@ def _sharded_core(
                 tol=cfg.tol,
                 all_sum=all_sum,
                 all_alive=all_alive,
+                targets_alive=targets_alive,
                 interpret=(platform != "tpu"),
                 axis_name=NODES_AXIS,
             )
@@ -152,6 +157,7 @@ def _sharded_core(
             all_alive=all_alive,
             targets_alive=targets_alive,
             edge_chunks=cfg.edge_chunks,
+            loss_windows=loss_windows,
         )
     if cfg.delivery == "invert":
         raise ValueError(
@@ -179,6 +185,7 @@ def _sharded_core(
         all_sum=all_sum,
         all_alive=all_alive,
         targets_alive=targets_alive,
+        loss_windows=loss_windows,
     )
 
 
@@ -287,10 +294,13 @@ def make_sharded_chunk_runner(
             round_fn = partial(core, shard_rd=nbrs, base_key=base_key)
         elif is_pushsum and cfg.fanout == "all":
             # diffusion: no draws, no gids — edges are pre-localized by
-            # source block, delivery is the same scatter2 collective
+            # source block, delivery is the same scatter2 collective.
+            # row_offset re-globalizes the local src ids so per-edge drop
+            # masks key on (global src, global dst) — sharding-invariant
             round_fn = partial(
                 core, nbrs=nbrs, base_key=base_key,
                 scatter=scatter2, alive_global=alive_g,
+                row_offset=shard * local_n,
             )
         elif is_pushsum:
             round_fn = partial(
@@ -451,7 +461,12 @@ def run_simulation_sharded(
         allow_all_alive=resume_allows_fast(topo, initial_state),
     )
     if initial_state is not None:
-        state = jax.device_put(pad_state(initial_state, n_padded), shardings)
+        # copy before placing: device_put of host numpy arrays is
+        # zero-copy on CPU, and the chunk runner donates its inputs —
+        # consuming the caller's checkpoint arrays in-place would be a
+        # surprising API
+        owned = jax.tree.map(np.array, pad_state(initial_state, n_padded))
+        state = jax.device_put(owned, shardings)
     seed = jnp.int32(cfg.seed)
 
     t0 = time.perf_counter()

@@ -138,6 +138,8 @@ def pushsum_diffusion_round_core(
     all_alive: bool = False,
     targets_alive: bool = False,
     edge_chunks: int = 1,
+    loss_windows: tuple = (),
+    row_offset=0,
 ) -> PushSumState:
     """One synchronous fanout-all round.
 
@@ -149,9 +151,28 @@ def pushsum_diffusion_round_core(
     ``targets_alive``: the dead set is component-closed, so an alive
     node's neighbors are alive and no per-edge target-liveness gather is
     needed — dead→dead edges ship a zero share and deliver nothing).
+
+    ``loss_windows`` adds a per-directed-edge Bernoulli drop mask keyed on
+    the **global** (src, dst) pair — ``row_offset`` globalizes the local
+    ``src`` indices under ``shard_map`` — so the mask is sharding-
+    invariant. A dropped edge's share stays with the sender via the same
+    delivered-count accounting the dead-target path uses.
     """
-    del base_key  # deterministic: fanout-all draws nothing
     dt = state.s.dtype
+    if loss_windows:
+        from gossipprotocol_tpu.protocols.sampling import (
+            LOSS_FOLD, drop_mask, loss_probability,
+        )
+        assert nbrs is not None, (
+            "per-edge loss needs an explicit edge list; the implicit "
+            "complete graph has none (RunConfig validation rejects this)"
+        )
+        key_loss = jax.random.fold_in(
+            jax.random.fold_in(base_key, state.round), LOSS_FOLD
+        )
+        p_loss = loss_probability(state.round, loss_windows)
+    else:
+        del base_key  # deterministic: fanout-all draws nothing
 
     if nbrs is None:
         # Implicit complete graph: in_i = Σ share − share_i. Mixes in one
@@ -202,7 +223,11 @@ def pushsum_diffusion_round_core(
     bounds = [e_total * k // edge_chunks for k in range(edge_chunks + 1)]
     in_s = jnp.zeros(rows, dt)
     in_w = jnp.zeros(rows, dt)
-    cnt = None if (all_alive or targets_alive) else jnp.zeros(rows, dt)
+    fast_alive = all_alive or targets_alive
+    # the delivered-count makes ``sent = share · cnt`` exact whenever any
+    # edge can fail to deliver — dead targets or dropped messages alike
+    needs_cnt = bool(loss_windows) or not fast_alive
+    cnt = jnp.zeros(rows, dt) if needs_cnt else None
     for lo, hi in zip(bounds[:-1], bounds[1:]):
         if hi == lo:
             continue
@@ -213,7 +238,7 @@ def pushsum_diffusion_round_core(
         # src is sorted (CSR order), so this gather streams
         es = share_s[src_k]
         ew = share_w[src_k]
-        if all_alive or targets_alive:
+        if fast_alive:
             deliver = val_k            # None = every edge delivers
         else:
             # arbitrary dead sets (mid-run faults): an edge delivers
@@ -221,8 +246,16 @@ def pushsum_diffusion_round_core(
             # shares so mass stays conserved among all rows
             alive_k = alive_global[dst_k]
             deliver = alive_k if val_k is None else (val_k & alive_k)
+        if loss_windows:
+            keep = ~drop_mask(
+                key_loss, p_loss, src_k + row_offset, dst_k
+            )
+            deliver = keep if deliver is None else (deliver & keep)
+        if needs_cnt:
             cnt = cnt + jax.ops.segment_sum(
-                deliver.astype(dt), src_k, num_segments=rows
+                (jnp.ones(src_k.shape, dt) if deliver is None
+                 else deliver.astype(dt)),
+                src_k, num_segments=rows,
             )
         if deliver is None:
             d_s, d_w = scatter(es, ew, dst_k)
@@ -233,12 +266,12 @@ def pushsum_diffusion_round_core(
             )
         in_s = in_s + d_s
         in_w = in_w + d_w
-    if all_alive or targets_alive:
-        sent_s = share_s * deg
-        sent_w = share_w * deg
-    else:
+    if needs_cnt:
         sent_s = share_s * cnt
         sent_w = share_w * cnt
+    else:
+        sent_s = share_s * deg
+        sent_w = share_w * deg
     return finish_pushsum_round(
         state, state.s - sent_s + in_s, state.w - sent_w + in_w,
         received=in_w > 0, eps=eps, streak_target=streak_target,
@@ -275,12 +308,20 @@ def pushsum_diffusion_round_routed(
     keeps ``1/(deg+1)`` of ``(s, w)`` and ships one share per edge — but
     delivery runs through the static routing plans of
     :mod:`gossipprotocol_tpu.ops.delivery` instead of two random-index
-    ``segment_sum`` scatters.  Legality matches the gather-inverted
-    deliveries: exact under ``all_alive`` / ``targets_alive`` (the dead
-    set component-closed, so dead nodes exchange only zero shares).
-    Trajectories equal the scatter path to float accumulation order.
+    ``segment_sum`` scatters. Trajectories equal the scatter path to
+    float accumulation order.
+
+    Fast paths (``all_alive`` / ``targets_alive``) ship every share and
+    keep ``sent = share · deg``. Under an **arbitrary** dead set
+    (mid-run fault strikes) the static plan can't mask per-edge targets,
+    so the general path recovers exactness algebraically: one extra
+    ``matvec(alive, alive)`` yields each node's count of *alive*
+    neighbors (``live_deg``, exact small-integer floats), the received
+    sums are masked to alive rows, and ``sent = share · live_deg`` — the
+    same values the scatter path's delivered-count accounting produces,
+    at ~1.5× the per-round cost while a fault plan is in force.
     """
-    del base_key, targets_alive  # deterministic; closure on legality above
+    del base_key  # deterministic: fanout-all draws nothing
     dt = state.s.dtype
     rows = state.s.shape[0]
     deg = routed.degree.astype(dt)
@@ -293,8 +334,18 @@ def pushsum_diffusion_round_routed(
         share_s = jnp.where(state.alive, share_s, 0)
         share_w = jnp.where(state.alive, share_w, 0)
     in_s, in_w = routed.matvec(share_s, share_w, interpret=interpret)
-    sent_s = share_s * deg
-    sent_w = share_w * deg
+    if all_alive or targets_alive:
+        sent_s = share_s * deg
+        sent_w = share_w * deg
+    else:
+        alive_f = state.alive.astype(dt)
+        live_deg, _ = routed.matvec(alive_f, alive_f, interpret=interpret)
+        # a dead receiver's in-sum is garbage only to itself: discard it
+        # (the sender already kept that share via live_deg below)
+        in_s = jnp.where(state.alive, in_s, 0)
+        in_w = jnp.where(state.alive, in_w, 0)
+        sent_s = share_s * live_deg
+        sent_w = share_w * live_deg
     return finish_pushsum_round(
         state, state.s - sent_s + in_s, state.w - sent_w + in_w,
         received=in_w > 0, eps=eps, streak_target=streak_target,
@@ -307,7 +358,7 @@ def pushsum_diffusion_round_routed(
     jax.jit,
     static_argnames=(
         "n", "eps", "streak_target", "predicate", "tol", "all_alive",
-        "targets_alive", "edge_chunks",
+        "targets_alive", "edge_chunks", "loss_windows",
     ),
     inline=True,
 )
@@ -324,6 +375,7 @@ def pushsum_diffusion_round(
     all_alive: bool = False,
     targets_alive: bool = False,
     edge_chunks: int = 1,
+    loss_windows: tuple = (),
 ) -> PushSumState:
     """Single-chip fanout-all round (same call shape as ``pushsum_round``)."""
 
@@ -347,4 +399,5 @@ def pushsum_diffusion_round(
         all_alive=all_alive,
         targets_alive=targets_alive,
         edge_chunks=edge_chunks,
+        loss_windows=loss_windows,
     )

@@ -37,7 +37,10 @@ import jax
 import jax.numpy as jnp
 
 from gossipprotocol_tpu.protocols.sampling import (
+    LOSS_FOLD,
     device_topology,
+    drop_mask,
+    loss_probability,
     sample_neighbors,
 )
 from gossipprotocol_tpu.protocols.state import GossipState
@@ -57,6 +60,7 @@ def gossip_round_core(
     all_alive: bool = False,
     inverted: bool = False,
     all_sum=jnp.sum,
+    loss_windows: tuple = (),
 ) -> GossipState:
     """One synchronous round over the rows in ``gids``.
 
@@ -88,6 +92,20 @@ def gossip_round_core(
     if not all_alive:
         spreaders = spreaders & state.alive
 
+    if loss_windows:
+        # a lost rumor message simply never lands (gossip needs no mass
+        # return — the sender's count is untouched by sending)
+        p_loss = loss_probability(state.round, loss_windows)
+        gid_rows = (
+            gids if gids is not None
+            else jnp.arange(state.counts.shape[0], dtype=jnp.int32)
+        )
+        dropped = drop_mask(
+            jax.random.fold_in(key, LOSS_FOLD), p_loss, gid_rows
+        )
+    else:
+        dropped = None
+
     if inverted:
         valid = nbrs.degree > 0
         eligible_spreading = spreaders & valid
@@ -100,16 +118,25 @@ def gossip_round_core(
 
         def deliver_scatter():
             targets, valid_s = sample_neighbors(nbrs, n, key, gids)
-            return scatter(
-                (spreaders & valid_s).astype(state.counts.dtype), targets
-            )
+            send = spreaders & valid_s
+            if dropped is not None:
+                send = send & ~dropped
+            return scatter(send.astype(state.counts.dtype), targets)
 
-        hits = jax.lax.cond(
-            mismatches == 0, deliver_inverted, deliver_scatter
-        )
+        # the inverted gather reproduces the scatter histogram only when
+        # every send is delivered; an active loss window breaks that, so
+        # the legality check gains a (traced) "no loss right now" term —
+        # a pure function of round + static window table, identical on
+        # every shard, so all shards still take the same branch
+        legal = mismatches == 0
+        if loss_windows:
+            legal = legal & (p_loss == jnp.float32(0.0))
+        hits = jax.lax.cond(legal, deliver_inverted, deliver_scatter)
     else:
         targets, valid = sample_neighbors(nbrs, n, key, gids)
         spreaders = spreaders & valid
+        if dropped is not None:
+            spreaders = spreaders & ~dropped
         hits = scatter(spreaders.astype(state.counts.dtype), targets)
     # the reference's sender-side dict check (Program.fs:87-88) — no hits
     # land on converged or failed receivers. Suppressing on the receiver
@@ -129,7 +156,10 @@ def gossip_round_core(
 
 @partial(
     jax.jit,
-    static_argnames=("n", "threshold", "keep_alive", "all_alive", "inverted"),
+    static_argnames=(
+        "n", "threshold", "keep_alive", "all_alive", "inverted",
+        "loss_windows",
+    ),
     inline=True,
 )
 def gossip_round(
@@ -142,6 +172,7 @@ def gossip_round(
     keep_alive: bool = True,
     all_alive: bool = False,
     inverted: bool = False,
+    loss_windows: tuple = (),
 ) -> GossipState:
     """Single-chip round. ``nbrs``/``base_key`` are runtime arguments so one
     compiled executable serves every same-shape topology and seed."""
@@ -156,6 +187,7 @@ def gossip_round(
         keep_alive=keep_alive,
         all_alive=all_alive,
         inverted=inverted,
+        loss_windows=loss_windows,
     )
 
 

@@ -39,7 +39,10 @@ import jax
 import jax.numpy as jnp
 
 from gossipprotocol_tpu.protocols.sampling import (
+    LOSS_FOLD,
     device_topology,
+    drop_mask,
+    loss_probability,
     sample_neighbors,
 )
 from gossipprotocol_tpu.protocols.state import PushSumState
@@ -64,6 +67,7 @@ def pushsum_round_core(
     all_alive: bool = False,
     targets_alive: bool = False,
     delivery: str = "scatter",
+    loss_windows: tuple = (),
 ) -> PushSumState:
     """One synchronous round over the rows in ``gids``.
 
@@ -107,8 +111,10 @@ def pushsum_round_core(
         # receiver-side gather delivery (see received_by_inversion): no
         # targets are materialized at all. Build-time validation pinned
         # the legality window: dense table, component-closed dead set,
-        # single-chip rows (gids is None).
+        # single-chip rows (gids is None), no loss windows (a dropped
+        # send must return mass to the sender, which the gather can't).
         assert gids is None, "delivery='invert' is single-chip only"
+        assert not loss_windows, "delivery='invert' cannot model loss"
         valid = nbrs.degree > 0
         deliver = valid if all_alive else (valid & state.alive)
         s_sent = jnp.where(deliver, state.s * 0.5, jnp.zeros_like(state.s))
@@ -129,6 +135,19 @@ def pushsum_round_core(
             deliver = valid & state.alive
         else:
             deliver = valid & state.alive & alive_global[targets]
+        if loss_windows:
+            # a dropped send keeps its (s, w) half at the sender — same
+            # mechanics as a dead target, so Σs/Σw is conserved and the
+            # global predicate / estimate_error stay meaningful
+            gid_rows = (
+                gids if gids is not None
+                else jnp.arange(state.s.shape[0], dtype=jnp.int32)
+            )
+            p = loss_probability(state.round, loss_windows)
+            drop = drop_mask(
+                jax.random.fold_in(key, LOSS_FOLD), p, gid_rows
+            )
+            deliver = deliver & ~drop
         s_sent = jnp.where(deliver, state.s * 0.5, jnp.zeros_like(state.s))
         w_sent = jnp.where(deliver, state.w * 0.5, jnp.zeros_like(state.w))
 
@@ -264,7 +283,7 @@ def finish_pushsum_round(
     jax.jit,
     static_argnames=(
         "n", "eps", "streak_target", "reference_semantics", "predicate",
-        "tol", "all_alive", "targets_alive", "delivery",
+        "tol", "all_alive", "targets_alive", "delivery", "loss_windows",
     ),
     inline=True,
 )
@@ -282,6 +301,7 @@ def pushsum_round(
     all_alive: bool = False,
     targets_alive: bool = False,
     delivery: str = "scatter",
+    loss_windows: tuple = (),
 ) -> PushSumState:
     """Single-chip round. ``nbrs``/``base_key`` are runtime arguments so one
     compiled executable serves every same-shape topology and seed."""
@@ -308,6 +328,7 @@ def pushsum_round(
         all_alive=all_alive,
         targets_alive=targets_alive,
         delivery=delivery,
+        loss_windows=loss_windows,
     )
 
 

@@ -180,6 +180,59 @@ def _per_node_randint(key: jax.Array, gids: jax.Array, maxval: jax.Array) -> jax
     return (u % mx).astype(jnp.int32)
 
 
+# Domain-separation constant folded into the round key before drop draws.
+# The drop decision and the target draw are both keyed on (round key, gid);
+# without a distinct fold the two hashes would be the *same* u32 stream and
+# node i's drop coin would correlate perfectly with its neighbor choice.
+LOSS_FOLD = 0x10553
+
+
+def loss_probability(rnd: jax.Array, windows) -> jax.Array:
+    """Active drop probability at round ``rnd`` (f32 scalar, traced).
+
+    ``windows`` is the static ``(start, stop, prob)`` tuple from
+    :meth:`FaultSchedule.static_loss_windows`. Overlapping windows compose
+    as independent Bernoulli drops: survive = Π (1 - pₖ·activeₖ). Because
+    the round number is read from device state, loss windows cost no host
+    round-trips and no chunk-boundary stops — the kernel turns itself on
+    and off.
+    """
+    survive = jnp.float32(1.0)
+    for start, stop, prob in windows:
+        active = (rnd >= jnp.int32(start)) & (rnd < jnp.int32(stop))
+        survive = survive * jnp.where(active, jnp.float32(1.0 - prob), 1.0)
+    return jnp.float32(1.0) - survive
+
+
+def drop_mask(
+    key: jax.Array,
+    prob: jax.Array,
+    ids: jax.Array,
+    ids2: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Per-message Bernoulli drop decisions, counter-based like every other
+    draw in the engine (see :func:`_per_node_randint`).
+
+    ``ids`` alone keys per-sender drops (fanout-one protocols send one
+    message per node); ``ids2`` adds the receiver id for per-edge drops
+    (fanout-all diffusion sends one message per directed edge). Both ids
+    are *global*, so the mask — hence the trajectory — is identical under
+    any sharding, and reproducible for a fixed seed. The caller must pass
+    a loss-folded key (``fold_in(round_key, LOSS_FOLD)``).
+    """
+    import jax.extend.random as jexr
+
+    kd = jax.random.key_data(key).astype(jnp.uint32)
+    a = ids.astype(jnp.uint32)
+    b = a if ids2 is None else ids2.astype(jnp.uint32)
+    # pair (a_i, b_i) via the same [x, y] -> threefry(x_i, y_i) layout
+    # trick documented in _per_node_randint
+    u = jexr.threefry_2x32(kd, jnp.concatenate([a, b]))[: a.shape[0]]
+    # u < prob·2³² drops; exact for prob 0 (never) and monotone in prob
+    thresh = (prob.astype(jnp.float32) * jnp.float32(4294967296.0))
+    return u.astype(jnp.float32) < thresh
+
+
 def recomputed_hits(nbrs: InvertedDense, key: jax.Array) -> jax.Array:
     """``hit[i, k]``: does neighbor ``table[i,k]``'s draw land on row i?
 

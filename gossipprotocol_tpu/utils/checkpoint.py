@@ -42,6 +42,11 @@ TRAJECTORY_FIELDS = (
     # order, exactly like delivery="invert" — a resume under a different
     # chunking silently continues a different-accumulation-order trajectory
     "edge_chunks",
+    # the fault schedule is part of the trajectory: resuming a faulted run
+    # under a different --fail-fraction (or a plain run under a fault plan)
+    # would splice two different worlds. Stored as a stable content digest
+    # — the schedule itself can be large (trajectory_meta normalizes it)
+    "fault_schedule",
 )
 
 
@@ -117,6 +122,14 @@ def trajectory_meta(cfg) -> dict:
     if meta.get("dtype") is not None:
         # jnp.float32 the class is not JSON-able; its dtype name is
         meta["dtype"] = np.dtype(meta["dtype"]).name
+    # the schedule is stored as its content digest: stable across
+    # equivalent spellings (legacy fault_plan dict vs FaultSchedule), small,
+    # and "none" for the no-fault run so plain resumes keep matching
+    from gossipprotocol_tpu.utils import faults
+
+    meta["fault_schedule"] = faults.as_schedule(
+        getattr(cfg, "fault_schedule", None), getattr(cfg, "fault_plan", None)
+    ).digest()
     return meta
 
 

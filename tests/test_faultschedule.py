@@ -1,0 +1,480 @@
+"""Fault-schedule engine tests (PR 2: robustness).
+
+Covers the declarative kill/revive/loss timeline end to end: model
+parsing + validation + digest identity, multi-strike plans (including
+batched due strikes after a resume), churn (kill -> revive with
+fresh-born state, majority-partition re-check), mass-conserving message
+loss on every delivery variant, and the sharding/routing equivalences
+the engine promises (single-chip vs --devices N; routed vs scatter at a
+fault round).
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.engine import resume_simulation
+from gossipprotocol_tpu.parallel import run_simulation_sharded
+from gossipprotocol_tpu.utils import checkpoint as ckpt
+from gossipprotocol_tpu.utils import faults
+from gossipprotocol_tpu.utils.faults import FaultSchedule, LossWindow
+
+
+# ---------------------------------------------------------------- model
+
+
+def test_schedule_from_events_normalizes_and_validates():
+    s = FaultSchedule.from_events(
+        kills={5: [3, 1, 3]}, revives={"9": [1]},
+        loss=(LossWindow(0, 10, 0.2),))
+    assert s.kills[5].tolist() == [1, 3]  # sorted, deduped
+    assert s.revives[9].tolist() == [1]
+    assert s.has_strikes and s.has_loss and bool(s)
+    assert s.static_loss_windows() == ((0, 10, 0.2),)
+    s.validate(num_nodes=16)
+    with pytest.raises(ValueError, match="out of range"):
+        s.validate(num_nodes=2)
+    with pytest.raises(ValueError, match="negative"):
+        FaultSchedule.from_events(kills={-1: [0]}).validate()
+    with pytest.raises(ValueError, match="order-ambiguous"):
+        FaultSchedule.from_events(
+            kills={7: [1, 2]}, revives={7: [2, 3]}).validate()
+    # same-round kill+revive of DISJOINT ids is fine
+    FaultSchedule.from_events(kills={7: [1]}, revives={7: [3]}).validate()
+    with pytest.raises(ValueError, match="prob"):
+        FaultSchedule(loss=(LossWindow(0, 10, 1.0),)).validate()
+    with pytest.raises(ValueError, match="empty or negative"):
+        FaultSchedule(loss=(LossWindow(10, 10, 0.1),)).validate()
+    assert not FaultSchedule() and not FaultSchedule().has_strikes
+
+
+def test_schedule_from_json(tmp_path):
+    doc = {
+        "kill": [{"round": 5, "ids": [3, 4]},
+                 {"round": 5, "ids": [4, 6]},       # merges by union
+                 {"round": 12, "fraction": 0.25, "seed": 7}],
+        "revive": [{"round": 30, "ids": [3, 4]}],
+        "loss": [{"start": 0, "stop": 15, "prob": 0.1}],
+    }
+    s = FaultSchedule.from_json(doc, num_nodes=16)
+    assert s.kills[5].tolist() == [3, 4, 6]
+    assert s.kills[12].size == 4  # round(16 * 0.25)
+    assert s.revives[30].tolist() == [3, 4]
+    assert s.loss == (LossWindow(0, 15, 0.1),)
+    # same doc from a file parses identically (the --fault-plan path)
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(doc))
+    assert FaultSchedule.from_json(str(p), num_nodes=16).digest() == s.digest()
+    with pytest.raises(ValueError, match="unknown key"):
+        FaultSchedule.from_json({"kil": []})
+    with pytest.raises(ValueError, match="node count"):
+        FaultSchedule.from_json({"kill": [{"round": 1, "fraction": 0.1}]})
+    with pytest.raises(ValueError, match="ids.*fraction|'ids' or 'fraction'"):
+        FaultSchedule.from_json({"kill": [{"round": 1}]}, num_nodes=8)
+
+
+def test_schedule_digest_identity():
+    a = FaultSchedule.from_events(kills={5: [1, 2]})
+    b = FaultSchedule.from_events(kills={5: [2, 1]})       # order-insensitive
+    c = FaultSchedule.from_events(kills={5: [1, 3]})
+    assert a.digest() == b.digest() != c.digest()
+    assert FaultSchedule().digest() == "none"
+    # the legacy fault_plan spelling digests identically to the explicit
+    # schedule — resume validation must not care how the kills were spelled
+    legacy = faults.as_schedule(None, {5: np.array([2, 1])})
+    assert legacy.digest() == a.digest()
+    # loss windows and revives contribute
+    assert FaultSchedule(loss=(LossWindow(0, 9, 0.2),)).digest() != "none"
+    assert (FaultSchedule.from_events(revives={5: [1, 2]}).digest()
+            != a.digest())
+
+
+def test_build_schedule_sugar():
+    s = faults.build_schedule(
+        64, fail_fraction=0.1, fail_round=5, revive_round=20,
+        drop_prob=0.2, drop_window=(3, 9), seed=4)
+    victims = s.kills[5]
+    assert victims.size == 6 and s.revives[20].tolist() == victims.tolist()
+    assert s.loss == (LossWindow(3, 9, 0.2),)
+    # drop without a window spans the whole run
+    s2 = faults.build_schedule(64, drop_prob=0.1, max_rounds=500)
+    assert s2.loss == (LossWindow(0, 500, 0.1),)
+    # nothing scheduled -> None, so plain runs keep the static fast paths
+    assert faults.build_schedule(64) is None
+    with pytest.raises(ValueError, match="fail-fraction"):
+        faults.build_schedule(64, revive_round=20)
+    with pytest.raises(ValueError, match="after"):
+        faults.build_schedule(64, fail_fraction=0.1, fail_round=9,
+                              revive_round=9)
+    with pytest.raises(ValueError, match="drop-prob"):
+        faults.build_schedule(64, drop_window=(0, 10))
+
+
+def test_checkpoint_meta_carries_schedule_digest():
+    sched = FaultSchedule.from_events(kills={5: [1]})
+    cfg = RunConfig(algorithm="gossip", fault_schedule=sched)
+    meta = ckpt.trajectory_meta(cfg)
+    assert meta["fault_schedule"] == sched.digest()
+    plain = ckpt.trajectory_meta(RunConfig(algorithm="gossip"))
+    assert plain["fault_schedule"] == "none"
+    # resuming under a different schedule is a mismatch; a pre-upgrade
+    # checkpoint (key absent) wildcards
+    assert not ckpt.field_matches(meta, "fault_schedule", "none")
+    assert ckpt.field_matches({}, "fault_schedule", sched.digest())
+
+
+# ------------------------------------------------------- strikes & churn
+
+
+def test_multi_strike_plan_kills_land_at_their_rounds():
+    """Several {round: ids} entries: each chunk stops at its event round
+    and the alive count steps down exactly there."""
+    topo = build_topology("full", 64)
+    sched = FaultSchedule.from_events(
+        kills={4: np.arange(6), 9: np.arange(6, 10), 15: [10]})
+    cfg = RunConfig(algorithm="gossip", seed=0, seed_node=20,
+                    fault_schedule=sched, chunk_rounds=64)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    by_round = {m["round"]: m["alive"] for m in res.metrics}
+    assert by_round[4] == 64
+    assert by_round[9] == 58
+    assert by_round[15] == 54
+    assert res.metrics[-1]["alive"] == 53
+
+
+def test_same_round_kill_and_revive_disjoint_ids():
+    """Batched due strikes in one event round: kills apply before revives,
+    and both land in the same between-chunk stop."""
+    topo = build_topology("full", 32)
+    sched = FaultSchedule.from_events(
+        kills={3: [1, 2], 10: [5, 6]}, revives={10: [1, 2]})
+    cfg = RunConfig(algorithm="gossip", seed=0, seed_node=20,
+                    fault_schedule=sched, chunk_rounds=64)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    alive = np.asarray(res.final_state.alive)
+    assert alive[[1, 2]].all() and not alive[[5, 6]].any()
+    assert res.metrics[-1]["alive"] == 30
+
+
+def test_kill_then_revive_reintegrates_into_convergence():
+    """Churn: revived nodes come back fresh-born, reattach to the
+    majority component, and the predicate counts them again — the run
+    only converges once the rejoiners have converged too."""
+    topo = build_topology("imp3D", 64)
+    sched = FaultSchedule.from_events(kills={5: [3, 4, 5]},
+                                      revives={20: [3, 4, 5]})
+    for algo in ("gossip", "push-sum"):
+        cfg = RunConfig(algorithm=algo, seed=0, predicate="global", tol=1e-4,
+                        fault_schedule=sched, chunk_rounds=16,
+                        max_rounds=50_000)
+        res = run_simulation(topo, cfg)
+        assert res.converged, algo
+        alive = np.asarray(res.final_state.alive)
+        assert alive[[3, 4, 5]].all(), algo
+        assert res.metrics[-1]["alive"] == 64, algo
+        if algo == "gossip":
+            # a rejoiner converged the normal way: threshold hearings
+            assert (np.asarray(res.final_state.counts)[[3, 4, 5]]
+                    >= cfg.threshold).all()
+        else:
+            assert res.estimate_error is not None
+            assert res.estimate_error <= 2e-4
+
+
+def test_revived_nodes_are_fresh_born_not_resurrected():
+    """A revive is a process restart from its initial value: gossip counts
+    reset to 0, push-sum (s, w) to the init values — bitwise what init
+    would produce, never the pre-death state."""
+    from gossipprotocol_tpu.engine.driver import build_protocol, revive_rows
+
+    topo = build_topology("full", 32)
+    cfg = RunConfig(algorithm="push-sum", seed=0)
+    state, *_ = build_protocol(topo, cfg)
+    init_s = np.asarray(state.s).copy()
+    # scribble over node 7 as a run would, then revive it
+    dirty = state._replace(
+        s=state.s.at[7].set(99.0), w=state.w.at[7].set(42.0),
+        streak=state.streak.at[7].set(3),
+        converged=state.converged.at[7].set(True))
+    fresh = revive_rows(dirty, np.array([7]), cfg, 32)
+    assert float(np.asarray(fresh.s)[7]) == init_s[7]  # bitwise init value
+    assert float(np.asarray(fresh.w)[7]) == 1.0
+    assert float(np.asarray(fresh.ratio)[7]) == init_s[7]
+    assert int(np.asarray(fresh.streak)[7]) == 0
+    assert not bool(np.asarray(fresh.converged)[7])
+    # untouched rows stay bitwise untouched
+    np.testing.assert_array_equal(np.asarray(fresh.s)[:7], init_s[:7])
+
+    gcfg = RunConfig(algorithm="gossip", seed=0)
+    gstate, *_ = build_protocol(topo, gcfg)
+    gdirty = gstate._replace(counts=gstate.counts.at[7].set(9),
+                             converged=gstate.converged.at[7].set(True))
+    gfresh = revive_rows(gdirty, np.array([7]), gcfg, 32)
+    assert int(np.asarray(gfresh.counts)[7]) == 0
+    assert not bool(np.asarray(gfresh.converged)[7])
+
+
+def test_revive_without_reattachment_stays_dead():
+    """Majority-partition rule applies to rejoiners: reviving a node whose
+    every neighbor is dead must leave it dead (it cannot reattach), not
+    hang the predicate waiting on an unreachable node."""
+    from gossipprotocol_tpu.topology import csr_from_edges
+
+    # path 0-1-2-3-4-5: kill 0,1,2; revive only 0 (its sole neighbor 1
+    # stays dead -> 0 cannot reattach to the majority component {3,4,5})
+    topo = csr_from_edges(
+        6, np.array([[0, 1], [1, 2], [2, 3], [3, 4], [4, 5]]), kind="path")
+    sched = FaultSchedule.from_events(kills={2: [0, 1, 2]}, revives={6: [0]})
+    cfg = RunConfig(algorithm="push-sum", seed=0, predicate="global",
+                    tol=1e-4, fault_schedule=sched, chunk_rounds=8,
+                    max_rounds=5_000)
+    res = run_simulation(topo, cfg)
+    assert res.converged, "unreattachable rejoiner must not hang the run"
+    alive = np.asarray(res.final_state.alive)
+    assert not alive[[0, 1, 2]].any()
+    assert alive[[3, 4, 5]].all()
+
+
+def test_resume_mid_schedule_replays_remaining_events(tmp_path):
+    """A checkpoint taken between strikes resumes into the same
+    trajectory: already-applied events (r < checkpoint round) are pruned,
+    pending ones still fire — bitwise the uninterrupted run."""
+    topo = build_topology("imp3D", 64)
+    sched = FaultSchedule.from_events(kills={6: [3, 4]}, revives={20: [3, 4]})
+    cfg = RunConfig(algorithm="push-sum", seed=3, predicate="global",
+                    tol=1e-4, fault_schedule=sched, chunk_rounds=8,
+                    max_rounds=50_000)
+    full = run_simulation(topo, cfg)
+    assert full.converged
+
+    cfg_a = dataclasses.replace(cfg, max_rounds=14, checkpoint_every=1,
+                                checkpoint_dir=str(tmp_path))
+    run_simulation(topo, cfg_a)
+    state, meta = ckpt.load(ckpt.latest(str(tmp_path)))
+    assert 6 < int(meta["round"]) < 20  # kill applied, revive pending
+    assert meta["fault_schedule"] == sched.digest()
+    resumed = resume_simulation(topo, cfg, state)
+    assert resumed.rounds == full.rounds
+    np.testing.assert_array_equal(np.asarray(resumed.final_state.s),
+                                  np.asarray(full.final_state.s))
+    np.testing.assert_array_equal(np.asarray(resumed.final_state.alive),
+                                  np.asarray(full.final_state.alive))
+
+
+# ---------------------------------------------------------- message loss
+
+
+@pytest.mark.parametrize("topology,n", [("line", 32), ("imp3D", 64),
+                                        ("power_law", 128)])
+def test_pushsum_converges_under_drop(topology, n):
+    """The acceptance bar: push-sum with 20% message loss still converges
+    with estimate_error at the no-loss tolerance — drops delay mixing but,
+    being mass-conserving, never bias the target."""
+    topo = build_topology(topology, n, seed=2)
+    sched = FaultSchedule(loss=(LossWindow(0, 10**9, 0.2),))
+    cfg = RunConfig(algorithm="push-sum", seed=2, predicate="global",
+                    tol=1e-4, fault_schedule=sched, max_rounds=200_000)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    assert res.estimate_error is not None and res.estimate_error <= 1e-4
+
+
+def test_gossip_converges_under_drop():
+    topo = build_topology("imp3D", 64)
+    sched = FaultSchedule(loss=(LossWindow(0, 10**9, 0.3),))
+    res = run_simulation(topo, RunConfig(algorithm="gossip", seed=1,
+                                         fault_schedule=sched,
+                                         max_rounds=50_000))
+    assert res.converged
+
+
+@pytest.mark.parametrize("fanout", ["one", "all"])
+def test_loss_is_mass_conserving(fanout):
+    """Σs and Σw are invariant under any drop rate (a dropped send keeps
+    its share at the sender) — the property that keeps estimate_error
+    meaningful under loss. Checked mid-run, far from convergence."""
+    topo = build_topology("imp3D", 64)
+    sched = FaultSchedule(loss=(LossWindow(0, 10**9, 0.5),))
+    cfg = RunConfig(algorithm="push-sum", seed=0, fanout=fanout,
+                    fault_schedule=sched, chunk_rounds=8, max_rounds=8)
+    res = run_simulation(topo, cfg)
+    s = np.asarray(res.final_state.s, dtype=np.float64)
+    w = np.asarray(res.final_state.w, dtype=np.float64)
+    n = topo.num_nodes
+    np.testing.assert_allclose(s.sum(), (n - 1) / 2, rtol=1e-5)  # Σ i/n
+    np.testing.assert_allclose(w.sum(), n, rtol=1e-5)
+
+
+def test_inactive_loss_window_is_bitwise_free():
+    """A schedule whose loss windows never activate inside the horizon
+    must reproduce the no-schedule trajectory bitwise — the drop masks
+    compile to exact no-ops at p=0, and gossip's inverted branch stays
+    legal whenever the active drop probability is zero."""
+    topo = build_topology("imp3D", 64)
+    late = FaultSchedule(loss=(LossWindow(10**5, 10**6, 0.5),))
+    for algo, field in (("gossip", "counts"), ("push-sum", "s")):
+        base = RunConfig(algorithm=algo, seed=5, chunk_rounds=32,
+                         max_rounds=10_000)
+        plain = run_simulation(topo, base)
+        lossy = run_simulation(
+            topo, dataclasses.replace(base, fault_schedule=late))
+        assert plain.rounds == lossy.rounds, algo
+        np.testing.assert_array_equal(
+            np.asarray(getattr(plain.final_state, field)),
+            np.asarray(getattr(lossy.final_state, field)), err_msg=algo)
+
+
+def test_drop_draws_are_reproducible():
+    """Same seed ⇒ identical lossy trajectory (counter-based drop coins)."""
+    topo = build_topology("line", 32)
+    sched = FaultSchedule(loss=(LossWindow(0, 10**9, 0.3),))
+    cfg = RunConfig(algorithm="gossip", seed=9, fault_schedule=sched,
+                    max_rounds=50_000)
+    r1, r2 = run_simulation(topo, cfg), run_simulation(topo, cfg)
+    assert r1.rounds == r2.rounds
+    np.testing.assert_array_equal(np.asarray(r1.final_state.counts),
+                                  np.asarray(r2.final_state.counts))
+
+
+# ------------------------------------------------ delivery equivalences
+
+
+def test_routed_vs_scatter_fault_round_bitwise_on_line():
+    """The lifted restriction: routed delivery through a kill round. On a
+    line graph every in-sum has <= 2 terms, so the routed matvec and the
+    scatter segment_sum reduce identical member sets in an
+    order-insensitive way — the trajectories must agree BITWISE, kill
+    round included (the live-degree path must match the delivered-count
+    accounting exactly)."""
+    topo = build_topology("line", 64)
+    sched = FaultSchedule.from_events(kills={5: [20, 21]},
+                                      revives={15: [20, 21]})
+    base = RunConfig(algorithm="push-sum", fanout="all", seed=1,
+                     predicate="global", tol=1e-4, fault_schedule=sched,
+                     chunk_rounds=8, max_rounds=100_000, plan_cache="none")
+    scatter = run_simulation(topo, dataclasses.replace(base,
+                                                       delivery="scatter"))
+    routed = run_simulation(topo, dataclasses.replace(base,
+                                                      delivery="routed"))
+    assert scatter.converged and routed.converged
+    assert scatter.rounds == routed.rounds
+    np.testing.assert_array_equal(np.asarray(scatter.final_state.s),
+                                  np.asarray(routed.final_state.s))
+    np.testing.assert_array_equal(np.asarray(scatter.final_state.w),
+                                  np.asarray(routed.final_state.w))
+
+
+def test_routed_vs_scatter_fault_round_allclose_on_imp3d():
+    """Higher-degree graphs accumulate in different float orders, so the
+    promise weakens to allclose — but round counts must still agree."""
+    topo = build_topology("imp3D", 64)
+    sched = FaultSchedule.from_events(kills={5: [3, 4, 5]})
+    base = RunConfig(algorithm="push-sum", fanout="all", seed=1,
+                     predicate="global", tol=1e-4, fault_schedule=sched,
+                     chunk_rounds=8, max_rounds=100_000, plan_cache="none")
+    scatter = run_simulation(topo, dataclasses.replace(base,
+                                                       delivery="scatter"))
+    routed = run_simulation(topo, dataclasses.replace(base,
+                                                      delivery="routed"))
+    assert scatter.converged and routed.converged
+    assert scatter.rounds == routed.rounds
+    np.testing.assert_allclose(np.asarray(scatter.final_state.s),
+                               np.asarray(routed.final_state.s), rtol=1e-5)
+
+
+@pytest.mark.parametrize("devices", [2, 4, 8])
+def test_single_vs_sharded_under_full_schedule(devices):
+    """Single-chip and --devices N runs of the same kill+revive+loss
+    schedule are the same trajectory: gossip (integer counts) bitwise;
+    push-sum to identical round counts."""
+    topo = build_topology("imp3D", 64)
+    sched = FaultSchedule.from_events(
+        kills={5: [3, 4, 5]}, revives={20: [3, 4, 5]},
+        loss=(LossWindow(0, 10**9, 0.2),))
+    cfg = RunConfig(algorithm="gossip", seed=0, fault_schedule=sched,
+                    max_rounds=50_000)
+    r1 = run_simulation(topo, cfg)
+    rd = run_simulation_sharded(topo, cfg, num_devices=devices)
+    assert r1.rounds == rd.rounds and r1.converged and rd.converged
+    np.testing.assert_array_equal(np.asarray(r1.final_state.counts),
+                                  np.asarray(rd.final_state.counts))
+    np.testing.assert_array_equal(np.asarray(r1.final_state.alive),
+                                  np.asarray(rd.final_state.alive))
+
+    cfg = RunConfig(algorithm="push-sum", seed=0, predicate="global",
+                    tol=1e-4, fault_schedule=sched, max_rounds=50_000)
+    p1 = run_simulation(topo, cfg)
+    pd = run_simulation_sharded(topo, cfg, num_devices=devices)
+    assert p1.rounds == pd.rounds and p1.converged and pd.converged
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def run_cli(args, capsys):
+    from gossipprotocol_tpu.cli import main
+
+    code = main(args)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+@pytest.mark.parametrize("flag,value", [("--fail-fraction", "1.5"),
+                                        ("--fail-fraction", "-0.1"),
+                                        ("--drop-prob", "1.0"),
+                                        ("--drop-prob", "nope")])
+def test_cli_rejects_out_of_range_fractions(flag, value, capsys):
+    """Range errors are argparse-level: usage message + exit 2, never a
+    ValueError traceback from inside the fault machinery."""
+    with pytest.raises(SystemExit) as exc:
+        run_cli(["27", "line", "gossip", flag, value], capsys)
+    assert exc.value.code == 2
+    assert "out of range" in capsys.readouterr().err or value == "nope"
+
+
+def test_cli_schedule_sugar_errors_exit_2(capsys):
+    code, _, err = run_cli(
+        ["27", "line", "gossip", "--drop-window", "5", "10"], capsys)
+    assert code == 2 and "--drop-prob" in err
+    code, _, err = run_cli(
+        ["27", "line", "gossip", "--revive-round", "9"], capsys)
+    assert code == 2 and "--fail-fraction" in err
+
+
+def test_cli_fault_plan_file_end_to_end(tmp_path, capsys):
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({
+        "kill": [{"round": 4, "ids": [3, 4]}],
+        "revive": [{"round": 12, "ids": [3, 4]}],
+        "loss": [{"start": 0, "stop": 8, "prob": 0.1}],
+    }))
+    code, out, _ = run_cli([
+        "64", "imp3D", "push-sum", "--backend", "cpu",
+        "--fault-plan", str(plan), "--predicate", "global", "--tol", "1e-4",
+        "--max-rounds", "100000",
+    ], capsys)
+    assert code == 0
+    assert "Convergence Time" in out
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"kill": [{"round": 4}]}))
+    code, _, err = run_cli(
+        ["64", "imp3D", "push-sum", "--backend", "cpu",
+         "--fault-plan", str(bad)], capsys)
+    assert code == 2 and "fault schedule invalid" in err
+
+
+def test_cli_drop_and_revive_sugar_end_to_end(capsys):
+    code, out, _ = run_cli([
+        "64", "imp3D", "push-sum", "--backend", "cpu",
+        "--fail-fraction", "0.1", "--fail-round", "5", "--revive-round", "20",
+        "--drop-prob", "0.15", "--drop-window", "0", "30",
+        "--predicate", "global", "--tol", "1e-4", "--max-rounds", "100000",
+    ], capsys)
+    assert code == 0
+    assert "Convergence Time" in out

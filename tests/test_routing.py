@@ -276,9 +276,18 @@ def test_routed_config_validation():
         RunConfig(algorithm="push-sum", fanout="one", delivery="routed")
     with pytest.raises(ValueError, match="fanout-all"):
         RunConfig(algorithm="gossip", delivery="routed")
-    with pytest.raises(ValueError, match="component-closed"):
-        RunConfig(algorithm="push-sum", fanout="all", delivery="routed",
-                  fault_plan={5: [1, 2]})
+    # kills/revives are now legal under routed delivery (the live-degree
+    # general path, PR 2) — only loss windows stay rejected: a static
+    # routing plan cannot thread per-edge drop masks
+    RunConfig(algorithm="push-sum", fanout="all", delivery="routed",
+              fault_plan={5: [1, 2]})
+    from gossipprotocol_tpu.utils import faults as _faults
+
+    with pytest.raises(ValueError, match="drop|loss"):
+        RunConfig(
+            algorithm="push-sum", fanout="all", delivery="routed",
+            fault_schedule=_faults.FaultSchedule.from_events(
+                loss=(_faults.LossWindow(0, 10, 0.2),)))
     with pytest.raises(ValueError, match="f32|float64"):
         RunConfig(algorithm="push-sum", fanout="all", delivery="routed",
                   dtype="float64")
