@@ -14,13 +14,56 @@ line's aux fields.
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def _probe_backend() -> None:
+    """Fast-fail when the accelerator worker is dead or unreachable.
+
+    ``jax.devices()`` against a dead remote TPU worker hangs the calling
+    process indefinitely — the 1M benchmark then burns its whole harness
+    budget producing nothing. The probe initializes the backend in a
+    THROWAWAY subprocess under a short timeout (``$BENCH_PROBE_TIMEOUT``
+    seconds, default 60; <=0 disables) and, on timeout or nonzero exit,
+    emits one parseable ``{"worker_down": true, "probe_s": ...}`` line
+    and exits nonzero, so a scheduler can distinguish "worker down" from
+    "benchmark regressed" without reading a traceback.
+
+    Limit: this only protects the probe's device init. If the image's
+    sitecustomize pre-initializes the backend at interpreter startup
+    (in-process, before main() runs), a dead worker hangs bench.py
+    before this line is reached — see README "Benchmark harness".
+    """
+    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
+    if timeout_s <= 0:
+        return
+    t0 = time.perf_counter()
+    code = "import jax; print(jax.default_backend(), len(jax.devices()))"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s)
+        ok = proc.returncode == 0
+        detail = (proc.stderr or proc.stdout).strip()[-200:]
+    except subprocess.TimeoutExpired:
+        ok = False
+        detail = f"device init exceeded {timeout_s:.0f}s"
+    if not ok:
+        print(json.dumps({
+            "worker_down": True,
+            "probe_s": round(time.perf_counter() - t0, 2),
+            "detail": detail,
+        }), flush=True)
+        sys.exit(3)
+
+
 def main():
+    _probe_backend()
+
     import jax
 
     from gossipprotocol_tpu import RunConfig, build_topology, run_simulation

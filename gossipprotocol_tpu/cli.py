@@ -74,6 +74,7 @@ def _build_config(args, algo, fault_schedule, jnp, alert_quorum=None):
         delivery=args.delivery,
         routed_design=args.routed_design or "push",
         plan_cache=args.plan_cache,
+        build_workers=args.build_workers,
         value_mode=args.value_mode,
         max_rounds=args.max_rounds,
         chunk_rounds=args.chunk_rounds,
@@ -259,6 +260,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "fingerprint; a hit loads bitwise the tables a "
                         "build would produce, skipping the O(E) "
                         "single-core compile (~37 min at 10M nodes)")
+    p.add_argument("--build-workers", type=int, default=None, metavar="N",
+                   help="processes for cold sharded-plan builds (default "
+                        "min(num_shards, cpu_count)). Per-shard plans "
+                        "build in a fork pool after a cheap geometry "
+                        "fixpoint; plans are bitwise-identical for every "
+                        "N, so this only trades build wall-time. 1 forces "
+                        "the serial builder")
     p.add_argument("--value-mode", choices=["scaled", "index"], default="scaled",
                    help="push-sum init: i/N (TPU-safe) or the reference's s_i=i")
     p.add_argument("--x64", action="store_true",

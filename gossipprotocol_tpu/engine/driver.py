@@ -94,6 +94,12 @@ class RunConfig:
                                    # NOT a trajectory field: a cache hit
                                    # loads bitwise the tables the build
                                    # produces (tests/test_routing.py)
+    build_workers: Optional[int] = None  # processes for cold sharded-plan
+                                   # builds; None = min(shards, cpus).
+                                   # NOT a trajectory field: plans are
+                                   # bitwise-identical across worker
+                                   # counts (tests/test_routing.py), so
+                                   # resume never depends on it
     routed_design: str = "push"    # sharded routed delivery: "push"
                                    # (owner-computes + all_to_all edge
                                    # shares, O(E/S + local_n) tables) |

@@ -99,6 +99,26 @@ def _configure_routecolor(lib: ctypes.CDLL) -> None:
             ctypes.c_int64, ctypes.c_int32, _I64P,
             np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
         ]
+    # stage-planner kernels are newer still; same probe-and-fallback rule
+    if hasattr(lib, "set_native_threads"):
+        lib.set_native_threads.restype = None
+        lib.set_native_threads.argtypes = [ctypes.c_int32]
+    if hasattr(lib, "plan_stage_count"):
+        lib.plan_stage_count.restype = ctypes.c_int64
+        lib.plan_stage_count.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            _I64P, _I32P, _I32P, ctypes.POINTER(ctypes.c_int64),
+        ]
+    if hasattr(lib, "plan_stage_place"):
+        lib.plan_stage_place.restype = ctypes.c_int64
+        # new_pos/perm passed as raw pointers: perm is optional (NULL
+        # skips the permutation fill on geometry-only passes) and
+        # ndpointer argtypes reject None
+        lib.plan_stage_place.argtypes = [
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            _I64P, _I32P, _I32P, ctypes.c_void_p, ctypes.c_void_p,
+        ]
 
 
 def _load_routecolor() -> Optional[ctypes.CDLL]:
@@ -168,6 +188,76 @@ def route_tiles_full(perms: np.ndarray, unit: int) -> Optional[np.ndarray]:
     if rc != 0:
         raise ValueError(f"route_tiles_full: non-injective perm (rc={rc})")
     return idx
+
+
+def set_native_threads(n: int) -> None:
+    """Clamp the OpenMP thread count of the native kernels (no-op when
+    the library is absent or predates the entry point). Used by the
+    shard-build worker pool to split host cores across workers; thread
+    count never affects results."""
+    lib = _load_routecolor()
+    if lib is not None and hasattr(lib, "set_native_threads"):
+        lib.set_native_threads(int(n))
+
+
+def plan_stage_pack(
+    pos: np.ndarray, bucket: np.ndarray, u: int, b: int, t_grid: int
+) -> Optional[Tuple[np.ndarray, int]]:
+    """Counting-sort run packing for one compiler stage (see
+    ``native/routecolor.cpp::plan_stage_count``).
+
+    ``pos``: int64 ``[F]`` distinct unit positions < ``t_grid * u``;
+    ``bucket``: ``[F]`` radix buckets in ``[0, b)``.  Returns
+    ``(rank, max_run)`` — each flow's rank within its (tile, bucket)
+    run in ascending-``pos`` order (bitwise the order the numpy stable
+    argsort assigns) and the longest run in units — or None when the
+    library (or this entry point) is unavailable.
+    """
+    lib = _load_routecolor()
+    if lib is None or not hasattr(lib, "plan_stage_count"):
+        return None
+    pos = np.ascontiguousarray(pos, dtype=np.int64)
+    bucket32 = np.ascontiguousarray(bucket, dtype=np.int32)
+    rank = np.empty(pos.size, np.int32)
+    max_run = ctypes.c_int64(0)
+    rc = lib.plan_stage_count(
+        pos.size, t_grid, u, b, pos, bucket32, rank,
+        ctypes.byref(max_run))
+    if rc != 0:
+        raise ValueError(
+            f"plan_stage_count: malformed flows (rc={rc}: "
+            f"{'duplicate pos' if rc == 2 else 'out of range'})")
+    return rank, int(max_run.value)
+
+
+def plan_stage_place(
+    pos: np.ndarray, bucket: np.ndarray, rank: np.ndarray,
+    u: int, unit: int, b: int, cr: int, o: int, tau_in: int,
+    tau_slab: int, perm: Optional[np.ndarray] = None,
+) -> Optional[np.ndarray]:
+    """Fused flow placement for one compiler stage (see
+    ``native/routecolor.cpp::plan_stage_place``).
+
+    Returns ``new_pos`` int64 ``[F]`` and, when ``perm`` (int64
+    ``[t_grid * o, u]`` pre-filled with -1) is given, scatters each
+    flow's source unit into it in place.  None when unavailable.
+    """
+    lib = _load_routecolor()
+    if lib is None or not hasattr(lib, "plan_stage_place"):
+        return None
+    pos = np.ascontiguousarray(pos, dtype=np.int64)
+    bucket32 = np.ascontiguousarray(bucket, dtype=np.int32)
+    rank32 = np.ascontiguousarray(rank, dtype=np.int32)
+    new_pos = np.empty(pos.size, np.int64)
+    if perm is not None:
+        assert perm.dtype == np.int64 and perm.flags.c_contiguous
+    rc = lib.plan_stage_place(
+        pos.size, u, unit, b, cr, o, tau_in, tau_slab, pos, bucket32,
+        rank32, new_pos.ctypes.data, perm.ctypes.data if perm is not None
+        else None)
+    if rc != 0:
+        raise ValueError(f"plan_stage_place: malformed geometry (rc={rc})")
+    return new_pos
 
 
 def _topo_csr64(topo):
@@ -266,6 +356,7 @@ def build_library(quiet: bool = True) -> str:
     # so the freshly built libraries get probed again
     _libs.pop(_LIB_PATH, None)
     _libs.pop(_ASYNC_LIB_PATH, None)
+    _libs.pop(_ROUTE_LIB_PATH, None)
     if _load() is None:
         raise RuntimeError(f"built {_LIB_PATH} but failed to load it")
     return _LIB_PATH

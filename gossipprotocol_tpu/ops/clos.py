@@ -67,16 +67,24 @@ def euler_color_numpy(src_rows: np.ndarray, dst_rows: np.ndarray,
             color[ids] = c0
             return
         # incidence lists over 2*ROWS vertices; entry 2k / 2k+1 = edge
-        # ids[k] seen from its left / right endpoint
+        # ids[k] seen from its left / right endpoint.  Built vectorized:
+        # a stable argsort groups entries by vertex in ascending entry
+        # order, so each group's chain (head = last entry, nxt = the
+        # previous one) reproduces the sequential scatter loop exactly —
+        # same traversal, bitwise-same coloring (the python spelling was
+        # ~60% of fallback build time at 1M pairs)
+        ne = 2 * len(ids)
+        vtx = np.empty(ne, np.int64)
+        vtx[0::2] = s[0][ids]
+        vtx[1::2] = ROWS + s[1][ids]
+        by_v = np.argsort(vtx, kind="stable")
+        vs = vtx[by_v]
+        first = np.r_[True, vs[1:] != vs[:-1]]
+        nxt = np.empty(ne, np.int64)
+        nxt[by_v] = np.where(first, -1, np.r_[-1, by_v[:-1]])
         head = np.full(2 * ROWS, -1, np.int64)
-        nxt = np.empty(2 * len(ids), np.int64)
-        for k, e in enumerate(ids):
-            u = s[0][e]
-            v = ROWS + s[1][e]
-            nxt[2 * k] = head[u]
-            head[u] = 2 * k
-            nxt[2 * k + 1] = head[v]
-            head[v] = 2 * k + 1
+        last = np.r_[first[1:], True] if ne else first
+        head[vs[last]] = by_v[last]
         used = np.zeros(len(ids), bool)
         halves = ([], [])
         for start in range(2 * ROWS):

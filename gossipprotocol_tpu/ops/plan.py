@@ -139,8 +139,111 @@ def _complete_bijections(perm: np.ndarray, u: int) -> np.ndarray:
     return out
 
 
+def _pack_stage(pos: np.ndarray, bucket: np.ndarray, u: int, b: int,
+                t_grid: int):
+    """Run packing for one stage: rank each flow within its
+    (tile, bucket) run in ascending-``pos`` order, and measure the
+    longest run (units).
+
+    Native counting pass when available (O(F + slots), OpenMP over
+    tiles); fallback is the combined-key stable argsort.  Both assign
+    identical ranks — a tile's unit slots are contiguous in pos space,
+    so scanning slots ascending within a tile visits its flows in
+    exactly the argsort's within-run order (asserted bitwise in
+    tests/test_native.py).
+    """
+    from gossipprotocol_tpu import native
+
+    got = native.plan_stage_pack(pos, bucket, u, b, t_grid)
+    if got is not None:
+        return got
+    tile = pos // u
+    # Combined-key argsort = the lexsort, 3.3x faster on this 1-core
+    # host (measured, 16M elements: 10.8 s -> 3.3 s); ranges fit
+    # int64 comfortably at every supported scale (pos < 2^36,
+    # tile*b + bucket < 2^27 at 100M nodes)
+    if pos.size and int(pos.max()) < (1 << 36) and (
+            int(tile.max()) * b + int(b) < (1 << 27)):
+        order = np.argsort(
+            ((tile * b + bucket) << np.int64(36)) | pos,
+            kind="stable")
+    else:
+        order = np.lexsort((pos, bucket, tile))
+    key = tile[order] * b + bucket[order]
+    run_start = np.r_[0, np.nonzero(np.diff(key))[0] + 1]
+    run_len = np.diff(np.r_[run_start, key.size])
+    rank = np.empty(pos.size, np.int64)
+    rank[order] = np.arange(key.size) - np.repeat(run_start, run_len)
+    return rank, int(run_len.max()) if key.size else 0
+
+
+def _place_stage(pos: np.ndarray, bucket: np.ndarray, rank: np.ndarray,
+                 u: int, unit: int, b: int, cr: int, o: int, tau_in: int,
+                 tau_slab: int, perm=None) -> np.ndarray:
+    """Flow placement for one stage: the staging-slab position of each
+    flow, plus (when ``perm`` is given) the per-(tile, o) output-slot
+    permutation scatter — native fused pass with a numpy mirror.
+    """
+    from gossipprotocol_tpu import native
+
+    got = native.plan_stage_place(pos, bucket, rank, u, unit, b, cr, o,
+                                  tau_in, tau_slab, perm=perm)
+    if got is not None:
+        return got
+    upr = 128 // unit
+    tile = pos // u
+    rr, rm = rank // upr, rank % upr
+    reg = tile // tau_in
+    tile_in_reg = tile - reg * tau_in
+    # staging rows: ((reg*b + bucket)*tau_slab + tile_in_reg)*cr + row
+    new_pos = ((((reg * b + bucket) * tau_slab + tile_in_reg) * cr + rr)
+               * upr + rm)
+    if perm is not None:
+        out_slot = (bucket * cr + rr) * upr + rm   # unit slot in [0, o*u)
+        perm.reshape(-1)[tile * (o * u) + out_slot] = pos % u
+    return new_pos
+
+
+_IDENTITY_IDX: dict = {}
+
+
+def _identity_routed_idx(unit: int) -> np.ndarray:
+    """The routed idx of an all-don't-care tile (completed to identity).
+
+    Sharded plans route heavily padded slabs — every shard's send/recv
+    tables are sized for the *largest* block, so most shards' perms are
+    dominated by tiles with no real entry at all. Any proper routing of
+    an empty tile is valid; this one is computed once and reused, which
+    is what makes the S-shard build cost scale with real edges instead
+    of padded slab size.
+    """
+    got = _IDENTITY_IDX.get(unit)
+    if got is None:
+        u = TILE // unit
+        got = _routed_idx_colored(np.full((1, u), -1, np.int64), unit)[0]
+        _IDENTITY_IDX[unit] = got
+    return got
+
+
 def _routed_idx(perm: np.ndarray, unit: int) -> np.ndarray:
     """Per-tile perms (``-1`` slots allowed) -> stacked int8 [R, 3, 128, 128].
+
+    All-don't-care tiles short-circuit to a shared identity route; the
+    rest go through the coloring backends.
+    """
+    perm = np.asarray(perm, np.int64)
+    empty = (perm < 0).all(axis=1)
+    if not empty.any():
+        return _routed_idx_colored(perm, unit)
+    out = np.empty((len(perm), 3, 128, 128), np.int8)
+    out[empty] = _identity_routed_idx(unit)
+    if not empty.all():
+        out[~empty] = _routed_idx_colored(perm[~empty], unit)
+    return out
+
+
+def _routed_idx_colored(perm: np.ndarray, unit: int) -> np.ndarray:
+    """The full completion + coloring + assembly pipeline.
 
     Native fused path (completion + coloring + assembly in one C++ pass,
     ~10x the numpy spelling on this 1-core host) with the original numpy
@@ -204,55 +307,32 @@ def build_route_plan(src_of: np.ndarray, m_in: int, unit: int = 2,
         bucket = ft_rel // span_next
         if (bucket < 0).any() or (bucket >= b).any():
             raise AssertionError("bucket out of range (compiler bug)")
-        # run packing: order flows by (tile, bucket), rank within run.
-        # Combined-key argsort = the lexsort, 3.3x faster on this 1-core
-        # host (measured, 16M elements: 10.8 s -> 3.3 s); ranges fit
-        # int64 comfortably at every supported scale (pos < 2^36,
-        # tile*b + bucket < 2^27 at 100M nodes)
-        if pos.size and int(pos.max()) < (1 << 36) and (
-                int(tile.max()) * b + int(b) < (1 << 27)):
-            order = np.argsort(
-                ((tile * b + bucket) << np.int64(36)) | pos,
-                kind="stable")
-        else:
-            order = np.lexsort((pos, bucket, tile))
-        tile_o, bucket_o, pos_o = tile[order], bucket[order], pos[order]
-        key = tile_o * b + bucket_o
-        run_start = np.r_[0, np.nonzero(np.diff(key))[0] + 1]
-        run_len = np.diff(np.r_[run_start, key.size])
-        rank = np.arange(key.size) - np.repeat(run_start, run_len)
+        # run packing: rank flows within their (tile, bucket) run; the
+        # longest run sets the stage's capacity
+        t_grid = p_regions * tau_in
+        rank, max_run = _pack_stage(pos, bucket, u, b, t_grid)
         upr = 128 // unit
-        max_rows = int(-(-run_len.max() // upr)) if key.size else 1
+        max_rows = int(-(-max_run // upr)) if pos.size else 1
         cr = _pow2_cr(max_rows)
         if cr_floors is not None and stage_no - 1 < len(cr_floors):
             cr = max(cr, int(cr_floors[stage_no - 1]))
         o = -(-b * cr // 128)
         tau_slab = -(-(tau_in * cr) // 128) * (128 // cr)
-        # output stacked-slot of each flow within its input tile's o tiles
-        out_row = bucket_o * cr + rank // upr
-        out_slot = out_row * upr + rank % upr   # unit slot in [0, o*u)
-        # new global position in the staging layout
-        # staging rows: ((reg*b + bucket)*tau_slab + tile_in_reg)*cr + row
-        tile_in_reg = tile_o - (tile_o // tau_in) * tau_in
-        reg_o = tile_o // tau_in
-        g_row = (((reg_o * b + bucket_o) * tau_slab + tile_in_reg) * cr
-                 + rank // upr)
-        new_pos = g_row * upr + rank % upr
-        # per-(tile, o) bijections
-        t_grid = p_regions * tau_in
+        # per-(tile, o) bijections + new positions in the staging layout
         if geometry_only:
             idx = None
+            new_pos = _place_stage(pos, bucket, rank, u, unit, b, cr, o,
+                                   tau_in, tau_slab)
         else:
             perm = np.full((t_grid * o, u), -1, np.int64)
-            which_o = out_slot // u
-            perm[tile_o * o + which_o, out_slot % u] = pos_o % u
+            new_pos = _place_stage(pos, bucket, rank, u, unit, b, cr, o,
+                                   tau_in, tau_slab, perm=perm)
             if progress:
                 progress(
                     f"stage {stage_no}: routing {t_grid * o} tile perms")
             idx = _routed_idx(perm, unit).reshape(t_grid, o, 3, 128, 128)
         stages.append(StagePass(p_regions, tau_in, b, cr, o, tau_slab, idx))
-        # advance flow positions (undo the sort)
-        pos[order] = new_pos
+        pos = new_pos
         p_regions *= b
         tau_in = tau_slab * cr // 128
         span = span_next

@@ -23,6 +23,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 import zipfile
 from typing import Optional
 
@@ -36,7 +37,45 @@ from gossipprotocol_tpu.ops.exec import DeviceFinal, DevicePlan, DeviceStage
 # stale-format entry must rebuild, not deserialize garbage.
 FORMAT_VERSION = 1
 
+# Provenance stamp only — bumped when the builder implementation changes
+# (parallel builds + incremental fixpoint = 2). NOT a cache-invalidation
+# key: builder revisions are required to produce bitwise-identical plans
+# (asserted in tests/test_routing.py), so old entries stay valid.
+BUILDER_VERSION = 2
+
 _PLAN_GROUPS = ("plan_in", "plan_m", "plan_out")
+
+
+def _provenance(build_s: float, build_workers: int) -> dict:
+    """Entry metadata recorded at save time and logged on save/load:
+    how long the build took, with how many workers, by which builder."""
+    return {
+        "builder": BUILDER_VERSION,
+        "build_s": round(float(build_s), 3),
+        "build_workers": int(build_workers),
+        "host_cpus": os.cpu_count(),
+    }
+
+
+def entry_provenance(path: str) -> Optional[dict]:
+    """The provenance dict of a cache entry, or None (absent entry,
+    pre-provenance entry, or unreadable metadata)."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+            return meta.get("provenance")
+    except (OSError, ValueError, KeyError, json.JSONDecodeError,
+            zipfile.BadZipFile):
+        return None
+
+
+def _provenance_note(path: str) -> str:
+    prov = entry_provenance(path)
+    if not prov:
+        return ""
+    return (f"; built in {prov.get('build_s', '?')}s with "
+            f"{prov.get('build_workers', '?')} workers "
+            f"(builder v{prov.get('builder', '?')})")
 
 
 def default_cache_dir() -> str:
@@ -94,7 +133,8 @@ def _unpack_plan(prefix: str, meta: dict, z) -> DevicePlan:
                       stages, fin)
 
 
-def save(rd: RoutedDelivery, path: str) -> None:
+def save(rd: RoutedDelivery, path: str,
+         provenance: Optional[dict] = None) -> None:
     """Serialize a HOST-side delivery (numpy leaves; ``device=False``)."""
     arrays: dict = {}
     meta = {
@@ -103,6 +143,8 @@ def save(rd: RoutedDelivery, path: str) -> None:
         "classes": [list(c) for c in rd.classes],
         "realmask_len": int(rd.realmask.shape[0]),
     }
+    if provenance:
+        meta["provenance"] = provenance
     for group in _PLAN_GROUPS:
         plans = getattr(rd, group)
         meta[group] = [
@@ -181,14 +223,18 @@ def routed_delivery_cached(topo, cache_dir: Optional[str] = None,
     rd = load(path)
     if rd is not None:
         if progress:
-            progress(f"routed delivery: plan cache hit ({path})")
+            progress(f"routed delivery: plan cache hit ({path})"
+                     f"{_provenance_note(path)}")
         return (to_device(rd) if device else rd), "hit"
+    t0 = time.perf_counter()
     rd = build_routed_delivery(topo, progress=progress, device=False)
+    prov = _provenance(time.perf_counter() - t0, build_workers=1)
     try:
-        save(rd, path)
+        save(rd, path, provenance=prov)
         _evict_over_budget(cache_dir, keep=path)
         if progress:
-            progress(f"routed delivery: plan cached ({path})")
+            progress(f"routed delivery: plan cached ({path}); "
+                     f"built in {prov['build_s']}s")
     except OSError as e:
         # a full disk / read-only cache dir must not cost the user the
         # build it just paid for — degrade to uncached, loudly
@@ -208,7 +254,8 @@ def shard_entry_path(cache_dir: str, key: str, n_padded: int,
         f"routedsh_v{FORMAT_VERSION}_{key}_p{n_padded}x{num_shards}.npz")
 
 
-def save_shards(stacked, path: str) -> None:
+def save_shards(stacked, path: str,
+                provenance: Optional[dict] = None) -> None:
     """Serialize a stacked ShardRoutedDelivery (numpy leaves, leading
     shard axis — exactly what build_shard_deliveries returns)."""
     arrays: dict = {}
@@ -222,6 +269,8 @@ def save_shards(stacked, path: str) -> None:
         "classes_tgt": [list(c) for c in stacked.classes_tgt],
         "realmask_shape": list(stacked.realmask.shape),
     }
+    if provenance:
+        meta["provenance"] = provenance
     for group in _PLAN_GROUPS:
         plans = getattr(stacked, group)
         meta[group] = [
@@ -283,30 +332,45 @@ def load_shards(path: str):
 
 
 def shard_deliveries_cached(topo, n_padded: int, num_shards: int,
-                            cache_dir: str | None = None, progress=None):
+                            cache_dir: str | None = None, progress=None,
+                            build_workers: Optional[int] = None):
     """Cache-aware build_shard_deliveries, same policy as
     :func:`routed_delivery_cached` (entries keyed by adjacency hash +
-    the mesh partition, since the plans depend on both)."""
-    from gossipprotocol_tpu.ops.sharddelivery import build_shard_deliveries
+    the mesh partition, since the plans depend on both).
+
+    ``build_workers`` controls the build-side process pool only — it
+    never affects the cache key because plans are bitwise-identical
+    across worker counts (tests/test_routing.py asserts this)."""
+    from gossipprotocol_tpu.ops.sharddelivery import (
+        build_shard_deliveries, resolve_build_workers,
+    )
 
     cache_dir = cache_dir or default_cache_dir()
     if cache_dir == "none":
-        return build_shard_deliveries(topo, n_padded, num_shards,
-                                      progress=progress), "off"
+        return build_shard_deliveries(
+            topo, n_padded, num_shards, progress=progress,
+            build_workers=build_workers), "off"
     path = shard_entry_path(cache_dir, cache_key(topo), n_padded,
                             num_shards)
     stacked = load_shards(path)
     if stacked is not None:
         if progress:
-            progress(f"sharded routed delivery: plan cache hit ({path})")
+            progress(f"sharded routed delivery: plan cache hit ({path})"
+                     f"{_provenance_note(path)}")
         return stacked, "hit"
+    t0 = time.perf_counter()
     stacked = build_shard_deliveries(topo, n_padded, num_shards,
-                                     progress=progress)
+                                     progress=progress,
+                                     build_workers=build_workers)
+    prov = _provenance(time.perf_counter() - t0,
+                       resolve_build_workers(build_workers, num_shards))
     try:
-        save_shards(stacked, path)
+        save_shards(stacked, path, provenance=prov)
         _evict_over_budget(cache_dir, keep=path)
         if progress:
-            progress(f"sharded routed delivery: plans cached ({path})")
+            progress(f"sharded routed delivery: plans cached ({path}); "
+                     f"built in {prov['build_s']}s with "
+                     f"{prov['build_workers']} workers")
     except OSError as e:
         import warnings
 
@@ -329,7 +393,8 @@ def push_entry_path(cache_dir: str, key: str, n_padded: int,
         f"routedpush_v{FORMAT_VERSION}_{key}_p{n_padded}x{num_shards}.npz")
 
 
-def save_push_shards(stacked, path: str) -> None:
+def save_push_shards(stacked, path: str,
+                     provenance: Optional[dict] = None) -> None:
     """Serialize a stacked ShardPushDelivery (numpy leaves, leading
     shard axis — what build_shard_push_deliveries returns)."""
     arrays: dict = {}
@@ -342,6 +407,8 @@ def save_push_shards(stacked, path: str) -> None:
         "classes": [list(c) for c in stacked.classes],
         "realmask_shape": list(stacked.realmask.shape),
     }
+    if provenance:
+        meta["provenance"] = provenance
     for group in _PUSH_PLAN_GROUPS:
         plans = getattr(stacked, group)
         meta[group] = [
@@ -405,32 +472,42 @@ def load_push_shards(path: str):
 
 def shard_push_deliveries_cached(topo, n_padded: int, num_shards: int,
                                  cache_dir: str | None = None,
-                                 progress=None):
+                                 progress=None,
+                                 build_workers: Optional[int] = None):
     """Cache-aware build_shard_push_deliveries, same policy as
     :func:`shard_deliveries_cached` (entries keyed by adjacency hash +
-    the mesh partition)."""
+    the mesh partition; ``build_workers`` is build-side only, never part
+    of the key)."""
     from gossipprotocol_tpu.ops.sharddelivery import (
-        build_shard_push_deliveries,
+        build_shard_push_deliveries, resolve_build_workers,
     )
 
     cache_dir = cache_dir or default_cache_dir()
     if cache_dir == "none":
         return build_shard_push_deliveries(
-            topo, n_padded, num_shards, progress=progress), "off"
+            topo, n_padded, num_shards, progress=progress,
+            build_workers=build_workers), "off"
     path = push_entry_path(cache_dir, cache_key(topo), n_padded,
                            num_shards)
     stacked = load_push_shards(path)
     if stacked is not None:
         if progress:
-            progress(f"push routed delivery: plan cache hit ({path})")
+            progress(f"push routed delivery: plan cache hit ({path})"
+                     f"{_provenance_note(path)}")
         return stacked, "hit"
+    t0 = time.perf_counter()
     stacked = build_shard_push_deliveries(topo, n_padded, num_shards,
-                                          progress=progress)
+                                          progress=progress,
+                                          build_workers=build_workers)
+    prov = _provenance(time.perf_counter() - t0,
+                       resolve_build_workers(build_workers, num_shards))
     try:
-        save_push_shards(stacked, path)
+        save_push_shards(stacked, path, provenance=prov)
         _evict_over_budget(cache_dir, keep=path)
         if progress:
-            progress(f"push routed delivery: plans cached ({path})")
+            progress(f"push routed delivery: plans cached ({path}); "
+                     f"built in {prov['build_s']}s with "
+                     f"{prov['build_workers']} workers")
     except OSError as e:
         import warnings
 

@@ -27,7 +27,10 @@ and their tables stack on a leading shard axis.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+import multiprocessing
+import os
+import time
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -129,6 +132,7 @@ def build_shard_delivery(
     caps_src: dict | None = None, caps_tgt: dict | None = None,
     cr_floors: dict | None = None,
     geometry_only: bool = False,
+    groups=None,
     progress=None,
 ) -> ShardRoutedDelivery:
     """Compile one shard's directed delivery for target rows [lo, hi).
@@ -143,11 +147,20 @@ def build_shard_delivery(
     ``geometry_only=True`` skips tile routing and returns the raw plan
     pairs ``{"in": ..., "m": ..., "out": ...}`` (idx tables None) — the
     cheap pre-pass that discovers the cross-shard cr maxima.
+    ``groups`` (geometry passes only) restricts that dict to a subset
+    of the plan groups, skipping the prelude work the others need —
+    the incremental fixpoint re-measures only what moved.
     """
     if topo.implicit_full:
         raise ValueError("shard delivery needs an explicit edge list")
     if topo.asymmetric:
         raise ValueError("shard delivery needs a symmetric simple graph")
+    if groups is None:
+        groups = ("in", "m", "out")
+    elif not geometry_only:
+        raise ValueError("groups subsetting is geometry_only-specific")
+    need_src = "in" in groups or "m" in groups
+    need_tgt = "m" in groups or "out" in groups
     n = topo.num_nodes
     local_n = hi - lo
     hi_real = min(hi, n)
@@ -161,82 +174,92 @@ def build_shard_delivery(
     # the directed restriction, enumerated by target row (CSR order):
     # edge k has target tgt[k] in [lo, hi_real) and source src[k] anywhere
     src = indices[offsets[lo]: offsets[hi_real]]
-    tgt = np.repeat(np.arange(lo, hi_real, dtype=np.int64),
-                    degree_full[lo:hi_real])
-    in_rank = (np.arange(len(src), dtype=np.int64)
-               - np.repeat(offsets[lo:hi_real] - offsets[lo],
-                           degree_full[lo:hi_real]))
 
-    # ---- expand side: sources classed by out-degree INTO the shard ----
-    out_deg = np.bincount(src, minlength=n)
-    cls_src = degree_classes(out_deg)
-    order_s, rank_s, nu_real = class_order(cls_src, n)
-    classes_src, start_src, m_pairs_src, pos_s = class_layout(
-        cls_src[order_s], caps=caps_src)
-    nu_src = sum(cap for *_, cap in classes_src)
+    if need_src:
+        # ---- expand side: sources classed by out-degree INTO the shard
+        out_deg = np.bincount(src, minlength=n)
+        cls_src = degree_classes(out_deg)
+        order_s, rank_s, nu_real = class_order(cls_src, n)
+        classes_src, start_src, m_pairs_src, pos_s = class_layout(
+            cls_src[order_s], caps=caps_src)
+        nu_src = sum(cap for *_, cap in classes_src)
 
-    # out-rank of each directed edge within its source's edge group
-    from gossipprotocol_tpu.ops.plan import argsort_pairs
+    if "m" in groups:
+        tgt = np.repeat(np.arange(lo, hi_real, dtype=np.int64),
+                        degree_full[lo:hi_real])
+        in_rank = (np.arange(len(src), dtype=np.int64)
+                   - np.repeat(offsets[lo:hi_real] - offsets[lo],
+                               degree_full[lo:hi_real]))
+        # out-rank of each directed edge within its source's edge group
+        from gossipprotocol_tpu.ops.plan import argsort_pairs
 
-    by_src = argsort_pairs(src, tgt, n)
-    src_o = src[by_src]
-    grp = np.r_[0, np.flatnonzero(np.diff(src_o)) + 1]
-    grp_len = np.diff(np.r_[grp, len(src_o)])
-    out_rank = np.empty(len(src), np.int64)
-    out_rank[by_src] = (np.arange(len(src_o))
-                        - np.repeat(grp, grp_len))
-    e1_slot = start_src[rank_s[src]] + out_rank
+        by_src = argsort_pairs(src, tgt, n)
+        src_o = src[by_src]
+        grp = np.r_[0, np.flatnonzero(np.diff(src_o)) + 1]
+        grp_len = np.diff(np.r_[grp, len(src_o)])
+        out_rank = np.empty(len(src), np.int64)
+        out_rank[by_src] = (np.arange(len(src_o))
+                            - np.repeat(grp, grp_len))
+        e1_slot = start_src[rank_s[src]] + out_rank
 
-    # ---- reduce side: targets classed by their full degree -----------
-    cls_tgt_full = np.zeros(n, np.int64)
-    cls_tgt_full[lo:hi_real] = degree_classes(degree_full[lo:hi_real])
-    order_t, rank_t, _ = class_order(cls_tgt_full, n)
-    classes_tgt, start_tgt, m_pairs_tgt, pos_t = class_layout(
-        cls_tgt_full[order_t], caps=caps_tgt)
-    nu_tgt = sum(cap for *_, cap in classes_tgt)
-    f_slot = start_tgt[rank_t[tgt]] + in_rank
+    if need_tgt:
+        # ---- reduce side: targets classed by their full degree -------
+        cls_tgt_full = np.zeros(n, np.int64)
+        cls_tgt_full[lo:hi_real] = degree_classes(degree_full[lo:hi_real])
+        order_t, rank_t, _ = class_order(cls_tgt_full, n)
+        classes_tgt, start_tgt, m_pairs_tgt, pos_t = class_layout(
+            cls_tgt_full[order_t], caps=caps_tgt)
+        nu_tgt = sum(cap for *_, cap in classes_tgt)
 
     if progress:
         progress(f"shard [{lo},{hi}): {len(src)} directed edges, "
-                 f"src classes {[(c, k) for c, k, *_ in classes_src]}, "
-                 f"tgt classes {[(c, k) for c, k, *_ in classes_tgt]}")
+                 f"src classes "
+                 f"{[(c, k) for c, k, *_ in classes_src] if need_src else '-'}, "
+                 f"tgt classes "
+                 f"{[(c, k) for c, k, *_ in classes_tgt] if need_tgt else '-'}")
 
     # ---- the three plans (stride-scrambled like the symmetric build).
     # plan_in/plan_out address CAPACITY-padded node-slot sequences (real
     # nodes at pos_s/pos_t, phantoms -1) so the matvec program is
     # identical on every shard built with the same caps.
     floors = cr_floors or {}
-    src_in = np.full(2 * nu_src, -1, np.int64)
-    src_in[2 * pos_s] = order_s
-    src_in[2 * pos_s + 1] = n + order_s
-    plans_in = _chained_plans(src_in, m_in=2 * n, progress=progress,
-                              unit=1, cr_floors=floors.get("in"),
-                              geometry_only=geometry_only)
+    out: dict = {}
+    if "in" in groups:
+        src_in = np.full(2 * nu_src, -1, np.int64)
+        src_in[2 * pos_s] = order_s
+        src_in[2 * pos_s + 1] = n + order_s
+        out["in"] = _chained_plans(src_in, m_in=2 * n, progress=progress,
+                                   unit=1, cr_floors=floors.get("in"),
+                                   geometry_only=geometry_only)
 
-    src_of_m = np.full(m_pairs_tgt, -1, np.int64)
-    src_of_m[f_slot] = e1_slot
-    realmask_pairs = np.zeros(m_pairs_src, bool)
-    realmask_pairs[e1_slot] = True
-    realmask = np.repeat(realmask_pairs, 2).astype(np.float32)
-    plans_m = _chained_plans(src_of_m, m_in=m_pairs_src,
-                             progress=progress,
-                             cr_floors=floors.get("m"),
-                             geometry_only=geometry_only)
+    if "m" in groups:
+        f_slot = start_tgt[rank_t[tgt]] + in_rank
+        src_of_m = np.full(m_pairs_tgt, -1, np.int64)
+        src_of_m[f_slot] = e1_slot
+        realmask_pairs = np.zeros(m_pairs_src, bool)
+        realmask_pairs[e1_slot] = True
+        realmask = np.repeat(realmask_pairs, 2).astype(np.float32)
+        out["m"] = _chained_plans(src_of_m, m_in=m_pairs_src,
+                                  progress=progress,
+                                  cr_floors=floors.get("m"),
+                                  geometry_only=geometry_only)
 
-    src_out = np.full(2 * local_n, -1, np.int64)
-    has = degree > 0
-    pos_of_row = np.full(n + (hi - hi_real), -1, np.int64)
-    pos_of_row[order_t] = pos_t
-    local_pos = pos_of_row[lo:hi]
-    src_out[:local_n][has] = 2 * local_pos[has]
-    src_out[local_n:][has] = 2 * local_pos[has] + 1
-    plans_out = _chained_plans(src_out, m_in=2 * nu_tgt,
-                               progress=progress, unit=1,
-                               cr_floors=floors.get("out"),
-                               geometry_only=geometry_only)
+    if "out" in groups:
+        src_out = np.full(2 * local_n, -1, np.int64)
+        has = degree > 0
+        pos_of_row = np.full(n + (hi - hi_real), -1, np.int64)
+        pos_of_row[order_t] = pos_t
+        local_pos = pos_of_row[lo:hi]
+        src_out[:local_n][has] = 2 * local_pos[has]
+        src_out[local_n:][has] = 2 * local_pos[has] + 1
+        out["out"] = _chained_plans(src_out, m_in=2 * nu_tgt,
+                                    progress=progress, unit=1,
+                                    cr_floors=floors.get("out"),
+                                    geometry_only=geometry_only)
 
     if geometry_only:
-        return {"in": plans_in, "m": plans_m, "out": plans_out}
+        return out
+    plans_in, plans_m, plans_out = out["in"], out["m"], out["out"]
 
     return ShardRoutedDelivery(
         n=n, local_n=local_n, nu_src=nu_src, nu_tgt=nu_tgt,
@@ -274,55 +297,225 @@ def _shard_class_counts(topo: Topology, bounds):
     return caps_src, caps_tgt
 
 
+# ---- multi-process shard builds ----------------------------------------
+#
+# The S per-shard compiles are independent pure functions of (topo slice,
+# caps, floors) — embarrassingly parallel host work. Shards build in a
+# fork-context ProcessPoolExecutor: children inherit the CSR arrays by
+# copy-on-write through the module-global snapshot below (nothing
+# n-scale is ever pickled; only the small per-task args and the result
+# tables cross the pipe), and results merge in shard-index order, so
+# plans are bitwise-identical for every worker count — including 1,
+# which skips the pool entirely (asserted in tests/test_routing.py).
+
+
+def resolve_build_workers(build_workers: Optional[int],
+                          num_shards: int) -> int:
+    """``--build-workers`` policy: default ``min(S, cpu_count)``,
+    clamped to [1, S] (more workers than shards would just idle)."""
+    if build_workers is None:
+        build_workers = min(num_shards, os.cpu_count() or 1)
+    return max(1, min(int(build_workers), num_shards))
+
+
+# Fork-snapshot state for pool workers: set by _ShardBuildPool BEFORE the
+# first submit (workers fork lazily at submit time and see a frozen
+# copy-on-write snapshot — per-task variables must travel in task args,
+# never through later mutations of this dict).
+_WORKER_STATE: dict = {}
+
+
+def _pool_initializer(omp_threads: int) -> None:
+    # W workers x the parent's OMP thread count would oversubscribe the
+    # host; split the cores across workers. Thread count never affects
+    # results (all native parallel writes are disjoint).
+    from gossipprotocol_tpu import native
+
+    native.set_native_threads(omp_threads)
+
+
+def _shard_build_task(task, progress=None):
+    """One (mode, shard, groups, cr_floors) unit — runs in pool workers
+    (reading the fork snapshot) and inline for the serial path."""
+    mode, k, groups, cr_floors = task
+    st = _WORKER_STATE
+    if st["kind"] == "pull":
+        bounds = st["bounds"]
+        return build_shard_delivery(
+            st["topo"], bounds[k], bounds[k + 1],
+            caps_src=st["caps_src"], caps_tgt=st["caps_tgt"],
+            cr_floors=cr_floors, geometry_only=(mode == "geo"),
+            groups=groups, progress=progress)
+    return build_shard_push_delivery(
+        st["topo"], st["n_padded"], st["num_shards"], k,
+        caps=st["caps"], block_pairs=st["block_pairs"],
+        cr_floors=cr_floors, geometry_only=(mode == "geo"),
+        groups=groups, progress=progress)
+
+
+class _ShardBuildPool:
+    """Runs shard-build tasks across ``workers`` forked processes, or
+    inline when ``workers == 1`` (or fork is unavailable). A broken
+    pool (OOM-killed worker, fork failure) degrades to the inline path
+    loudly — never a lost build."""
+
+    def __init__(self, workers: int, state: dict, progress=None):
+        self.progress = progress
+        self.pool = None
+        _WORKER_STATE.clear()
+        _WORKER_STATE.update(state)
+        if workers > 1 and "fork" in multiprocessing.get_all_start_methods():
+            from concurrent.futures import ProcessPoolExecutor
+
+            omp = max(1, (os.cpu_count() or 1) // workers)
+            try:
+                self.pool = ProcessPoolExecutor(
+                    max_workers=workers,
+                    mp_context=multiprocessing.get_context("fork"),
+                    initializer=_pool_initializer, initargs=(omp,))
+            except OSError as e:
+                if progress:
+                    progress(f"build pool unavailable ({e}); "
+                             "building shards serially")
+
+    def run(self, tasks):
+        """Results in task order; per-task progress only when inline."""
+        if self.pool is not None:
+            import warnings as _warnings
+
+            from concurrent.futures.process import BrokenProcessPool
+
+            try:
+                with _warnings.catch_warnings():
+                    # jax's atfork hook flags every fork as a potential
+                    # deadlock; these workers never touch jax (the build
+                    # is pure numpy + native), so the blanket warning is
+                    # noise here. Scoped to the submits that fork.
+                    _warnings.filterwarnings(
+                        "ignore", message="os.fork\\(\\) was called",
+                        category=RuntimeWarning)
+                    futs = [self.pool.submit(_shard_build_task, t)
+                            for t in tasks]
+                return [f.result() for f in futs]
+            except (BrokenProcessPool, OSError) as e:
+                import warnings
+
+                warnings.warn(
+                    f"shard build pool died ({e}); rebuilding serially")
+                self._shutdown(kill=True)
+        return [_shard_build_task(t, progress=self.progress)
+                for t in tasks]
+
+    def _shutdown(self, kill: bool = False) -> None:
+        if self.pool is not None:
+            self.pool.shutdown(wait=not kill, cancel_futures=kill)
+            self.pool = None
+
+    def close(self) -> None:
+        self._shutdown()
+        _WORKER_STATE.clear()
+
+
+def _uniform_cr_fixpoint(groups, num_shards: int, pool: _ShardBuildPool,
+                         progress=None):
+    """Cross-shard cr-floor fixpoint, re-measuring only what moved.
+
+    Geometry passes are cheap but O(E/S) each; the old loop re-ran all
+    S x groups every round. Incremental rule: a (shard, group) whose
+    last measured per-stage crs EQUAL the new floors would rebuild
+    identically (cr_i = max(natural_i, floor_i) and natural_i <= its
+    last value by induction over stages — forcing the same floors on
+    the same data reproduces the same packing), so only pairs whose
+    measurement differs from the floors are re-measured. Floors are
+    monotone nondecreasing and bounded (pow2 <= 128), so this
+    terminates — at exactly the fixpoint the full recomputation
+    reaches, measured round by round: both iterate floors_{t+1} =
+    max_k measure_k(floors_t), the skipped shards contributing their
+    (identical-by-the-lemma) cached measurements.
+    """
+    groups = tuple(groups)
+    crs: dict = {}
+    floors = None  # first pass: natural geometry, like the old loop
+    pending = [(k, groups) for k in range(num_shards)]
+    rounds = 0
+    while pending:
+        rounds += 1
+        results = pool.run([("geo", k, gs, floors) for k, gs in pending])
+        for (k, gs), geo in zip(pending, results):
+            for g in gs:
+                crs[(k, g)] = tuple(
+                    tuple(st.cr for st in plan.stages) for plan in geo[g])
+        floors_now = {}
+        for g in groups:
+            per_shard = [crs[(k, g)] for k in range(num_shards)]
+            shape0 = tuple(len(t) for t in per_shard[0])
+            for ps in per_shard[1:]:
+                if tuple(len(t) for t in ps) != shape0:
+                    raise AssertionError(
+                        "per-shard stage counts diverged (uniform m "
+                        "should fix them — compiler bug)")
+            floors_now[g] = tuple(
+                tuple(max(ps[pi][si] for ps in per_shard)
+                      for si in range(len(per_shard[0][pi])))
+                for pi in range(len(per_shard[0])))
+        nxt: dict = {}
+        for g in groups:
+            for k in range(num_shards):
+                if crs[(k, g)] != floors_now[g]:
+                    nxt.setdefault(k, []).append(g)
+        floors = floors_now
+        pending = sorted((k, tuple(gs)) for k, gs in nxt.items())
+        if progress:
+            progress(f"geometry fixpoint round {rounds}: "
+                     f"{sum(len(gs) for _, gs in pending)} shard-group "
+                     "re-measures pending")
+    return floors
+
+
 def build_shard_deliveries(topo: Topology, n_padded: int, num_shards: int,
-                           progress=None) -> ShardRoutedDelivery:
+                           progress=None,
+                           build_workers: Optional[int] = None,
+                           ) -> ShardRoutedDelivery:
     """All shards' deliveries, geometry-uniform, leaves stacked on a
     leading shard axis (shard k's tables at index k — exactly the
     layout ``shard_map`` wants sharded over the mesh's node axis).
+
+    ``build_workers``: processes for the per-shard compiles (default
+    ``min(S, cpu_count)``); the output is bitwise-independent of it.
     """
     local = n_padded // num_shards
     bounds = [k * local for k in range(num_shards + 1)]
     caps_src, caps_tgt = _shard_class_counts(topo, bounds)
+    workers = resolve_build_workers(build_workers, num_shards)
 
-    # geometry pre-passes (cheap, no tile routing): each shard's natural
-    # per-stage run capacities; the cross-shard maxima become every
-    # shard's floors — cr drives o/tau_slab/final-k, so uniform cr means
-    # one program. Iterated to a FIXPOINT: forcing a larger cr at stage
-    # i repacks the staging rows feeding stage i+1, so a floored build's
-    # natural cr at a later stage can exceed the unfloored measurement
-    # (found by code review); maxima are monotone and cr is a pow2
-    # <= 128, so this converges in <= ~7 passes (1-2 typical).
-    cr_floors = None
-    while True:
-        cr_max: dict = {}
-        for lo, hi in zip(bounds[:-1], bounds[1:]):
-            geo = build_shard_delivery(
-                topo, lo, hi, caps_src=caps_src, caps_tgt=caps_tgt,
-                cr_floors=cr_floors, geometry_only=True)
-            for group, pair in geo.items():
-                for pi, plan in enumerate(pair):
-                    crs = tuple(st.cr for st in plan.stages)
-                    key = (group, pi)
-                    prev = cr_max.get(key, (0,) * len(crs))
-                    if len(prev) != len(crs):
-                        raise AssertionError(
-                            "per-shard stage counts diverged (uniform m "
-                            "should fix them — compiler bug)")
-                    cr_max[key] = tuple(
-                        max(a, b) for a, b in zip(prev, crs))
-        floors_now = {
-            g: (cr_max[(g, 0)], cr_max[(g, 1)])
-            for g in ("in", "m", "out")
-        }
-        if floors_now == cr_floors:
-            break
-        cr_floors = floors_now
-
-    shards = []
-    for lo, hi in zip(bounds[:-1], bounds[1:]):
-        shards.append(build_shard_delivery(
-            topo, lo, hi, caps_src=caps_src, caps_tgt=caps_tgt,
-            cr_floors=cr_floors, progress=progress))
+    pool = _ShardBuildPool(
+        workers,
+        {"kind": "pull", "topo": topo, "bounds": bounds,
+         "caps_src": caps_src, "caps_tgt": caps_tgt},
+        progress=progress)
+    try:
+        # geometry pre-passes (cheap, no tile routing): each shard's
+        # natural per-stage run capacities; the cross-shard maxima
+        # become every shard's floors — cr drives o/tau_slab/final-k,
+        # so uniform cr means one program. Iterated to a FIXPOINT:
+        # forcing a larger cr at stage i repacks the staging rows
+        # feeding stage i+1, so a floored build's natural cr at a later
+        # stage can exceed the unfloored measurement (found by code
+        # review); maxima are monotone and cr is a pow2 <= 128, so this
+        # converges in <= ~7 passes (1-2 typical).
+        cr_floors = _uniform_cr_fixpoint(
+            ("in", "m", "out"), num_shards, pool, progress=progress)
+        # the expensive tile-routing pass runs exactly once per shard,
+        # under the converged floors
+        t0 = time.perf_counter()
+        shards = pool.run([("full", k, None, cr_floors)
+                           for k in range(num_shards)])
+        if progress:
+            progress(f"routed {num_shards} shard plans in "
+                     f"{time.perf_counter() - t0:.1f}s "
+                     f"({workers} workers)")
+    finally:
+        pool.close()
 
     def program_geometry(sd):
         # everything the compiled matvec program depends on. Per-shard
@@ -493,6 +686,7 @@ def build_shard_push_delivery(
     caps: dict | None = None, block_pairs: int | None = None,
     cr_floors: dict | None = None,
     geometry_only: bool = False,
+    groups=None,
     progress=None,
 ):
     """Compile one shard's push-design delivery (owned rows only).
@@ -502,7 +696,9 @@ def build_shard_push_delivery(
     the all_to_all block capacity, ``cr_floors`` forces per-stage run
     capacities (``{"in"|"send"|"recv"|"out"}``), and
     ``geometry_only=True`` returns the raw plan pairs for the cheap
-    cross-shard maxima pre-pass.
+    cross-shard maxima pre-pass — restricted to the ``groups`` subset
+    when given (the incremental fixpoint re-measures only what moved;
+    the edge-sort prelude is skipped unless send/recv are requested).
     """
     from gossipprotocol_tpu.ops.delivery import RoutedConfigError
 
@@ -512,6 +708,11 @@ def build_shard_push_delivery(
     if topo.asymmetric:
         raise RoutedConfigError(
             "push delivery needs a symmetric simple graph")
+    if groups is None:
+        groups = ("in", "send", "recv", "out")
+    elif not geometry_only:
+        raise ValueError("groups subsetting is geometry_only-specific")
+    need_edges = "send" in groups or "recv" in groups
     n = topo.num_nodes
     local = n_padded // num_shards
     lo = shard * local
@@ -529,114 +730,129 @@ def build_shard_push_delivery(
         cls[order], caps=caps)
     nu = sum(cap for *_, cap in classes)
 
-    # the shard's CSR slice: entry j = (row[j], nbr[j]); slot[j] is BOTH
-    # the e1 slot of out-edge row->nbr and the f slot of in-edge
-    # nbr->row, because the two sides share one layout
-    nbr = indices[offsets[lo]: offsets[hi_real]]
-    row = np.repeat(np.arange(lo, hi_real, dtype=np.int64),
-                    degree_full[lo:hi_real])
-    pos_in_row = (np.arange(len(nbr), dtype=np.int64)
-                  - np.repeat(offsets[lo:hi_real] - offsets[lo],
-                              degree_full[lo:hi_real]))
-    slot = node_start_pair[rank[row - lo]] + pos_in_row
-    nbr_shard = nbr // local
-    is_local = nbr_shard == shard
+    if need_edges:
+        # the shard's CSR slice: entry j = (row[j], nbr[j]); slot[j] is
+        # BOTH the e1 slot of out-edge row->nbr and the f slot of
+        # in-edge nbr->row, because the two sides share one layout
+        nbr = indices[offsets[lo]: offsets[hi_real]]
+        row = np.repeat(np.arange(lo, hi_real, dtype=np.int64),
+                        degree_full[lo:hi_real])
+        pos_in_row = (np.arange(len(nbr), dtype=np.int64)
+                      - np.repeat(offsets[lo:hi_real] - offsets[lo],
+                                  degree_full[lo:hi_real]))
+        slot = node_start_pair[rank[row - lo]] + pos_in_row
+        nbr_shard = nbr // local
+        is_local = nbr_shard == shard
 
-    realmask_pairs = np.zeros(m_pairs, bool)
-    realmask_pairs[slot] = True
-    realmask = np.repeat(realmask_pairs, 2).astype(np.float32)
+        if not geometry_only:
+            realmask_pairs = np.zeros(m_pairs, bool)
+            realmask_pairs[slot] = True
+            realmask = np.repeat(realmask_pairs, 2).astype(np.float32)
 
-    from gossipprotocol_tpu.ops.plan import argsort_pairs
+        from gossipprotocol_tpu.ops.plan import argsort_pairs
 
-    # ---- intra-shard edges: e1 -> f directly, no exchange ------------
-    # the local directed edge set is closed under reversal; sorting it
-    # by (row, nbr) and by (nbr, row) pairs every edge with its reverse
-    # at equal positions, and the f slot of u->v is the slot of entry
-    # (row=v, nbr=u) while its expanded value sits at the reverse
-    # entry's e1 slot
-    li = np.flatnonzero(is_local)
-    p1 = li[argsort_pairs(row[li], nbr[li], n)]
-    p2 = li[argsort_pairs(nbr[li], row[li], n)]
+        # ---- intra-shard edges: e1 -> f directly, no exchange --------
+        # the local directed edge set is closed under reversal; sorting
+        # it by (row, nbr) and by (nbr, row) pairs every edge with its
+        # reverse at equal positions, and the f slot of u->v is the
+        # slot of entry (row=v, nbr=u) while its expanded value sits at
+        # the reverse entry's e1 slot
+        li = np.flatnonzero(is_local)
+        p1 = li[argsort_pairs(row[li], nbr[li], n)]
+        xi = np.flatnonzero(~is_local)
 
-    # ---- cross-shard edges -------------------------------------------
-    # outbound: entry as out-edge row->nbr goes to shard nbr//local;
-    # block contents canonically ordered by (target, source) = (nbr,
-    # row) — computable identically on both endpoints at build time
-    xi = np.flatnonzero(~is_local)
-    po = xi[argsort_pairs(nbr[xi], row[xi], n)]
-    d_sorted = nbr_shard[po]  # non-decreasing (shard monotone in nbr)
-    starts = np.r_[0, np.flatnonzero(np.diff(d_sorted)) + 1]
-    lens = np.diff(np.r_[starts, len(d_sorted)])
-    rank_in_block = (np.arange(len(po), dtype=np.int64)
-                     - np.repeat(starts, lens))
-    # symmetric graph: this one bincount is both the outbound and the
-    # inbound per-shard block census (entry (row, nbr) is one edge pair)
-    bmax = int(np.bincount(d_sorted, minlength=num_shards).max()) \
-        if len(xi) else 0
-    if block_pairs is None:
-        block_pairs = max(64, -(-max(bmax, 1) // 64) * 64)
-    if bmax > block_pairs:
-        raise AssertionError(
-            "forced block capacity below this shard's natural maximum")
-    slab_pairs = num_shards * block_pairs
-
-    # plan_send: e1 -> [f_local | slab] (see the design note above)
-    src_of_send = np.full(m_pairs + slab_pairs, -1, np.int64)
-    src_of_send[slot[p1]] = slot[p2]
-    src_of_send[m_pairs + d_sorted * block_pairs + rank_in_block] = \
-        slot[po]
-
-    # plan_recv: [f_local | incoming] -> f. Local-edge f slots read
-    # their own position in part 1; cross-edge f slots read their
-    # incoming block slot. The same entries read as in-edges nbr->row
-    # come from source shard nbr//local, and within a block the
-    # sender's (target, source) order is our (row, nbr) order — the
-    # CSR enumeration order — so a stable sort by source shard
-    # reproduces the sender's block layout
-    pr = xi[np.argsort(nbr_shard[xi], kind="stable")]
-    s_sorted = nbr_shard[pr]
-    starts_r = np.r_[0, np.flatnonzero(np.diff(s_sorted)) + 1]
-    lens_r = np.diff(np.r_[starts_r, len(s_sorted)])
-    rank_r = (np.arange(len(pr), dtype=np.int64)
-              - np.repeat(starts_r, lens_r))
-    src_of_recv = np.full(m_pairs, -1, np.int64)
-    src_of_recv[slot[p1]] = slot[p1]
-    src_of_recv[slot[pr]] = (m_pairs + s_sorted * block_pairs + rank_r)
+    if "send" in groups:
+        p2 = li[argsort_pairs(nbr[li], row[li], n)]
+        # ---- cross-shard edges ---------------------------------------
+        # outbound: entry as out-edge row->nbr goes to shard nbr//local;
+        # block contents canonically ordered by (target, source) =
+        # (nbr, row) — computable identically on both endpoints at
+        # build time
+        po = xi[argsort_pairs(nbr[xi], row[xi], n)]
+        d_sorted = nbr_shard[po]  # non-decreasing (shard monotone)
+        starts = np.r_[0, np.flatnonzero(np.diff(d_sorted)) + 1]
+        lens = np.diff(np.r_[starts, len(d_sorted)])
+        rank_in_block = (np.arange(len(po), dtype=np.int64)
+                         - np.repeat(starts, lens))
+        # symmetric graph: this one bincount is both the outbound and
+        # the inbound per-shard block census (entry (row, nbr) is one
+        # edge pair)
+        bmax = int(np.bincount(d_sorted, minlength=num_shards).max()) \
+            if len(xi) else 0
+        if block_pairs is None:
+            block_pairs = max(64, -(-max(bmax, 1) // 64) * 64)
+        if bmax > block_pairs:
+            raise AssertionError(
+                "forced block capacity below this shard's natural "
+                "maximum")
+    slab_pairs = (num_shards * block_pairs
+                  if block_pairs is not None else None)
 
     if progress:
-        progress(f"push shard {shard}: {len(nbr)} owned directed edges "
-                 f"({len(xi)} cross), block {block_pairs} pairs, "
+        progress(f"push shard {shard}: "
+                 f"{len(nbr) if need_edges else '-'} owned directed "
+                 f"edges, block {block_pairs} pairs, "
                  f"classes {[(c, k) for c, k, *_ in classes]}")
 
     floors = cr_floors or {}
-    src_in = np.full(2 * nu, -1, np.int64)
-    src_in[2 * pos] = order
-    src_in[2 * pos + 1] = local + order
-    plans_in = _chained_plans(src_in, m_in=2 * local, progress=progress,
-                              unit=1, cr_floors=floors.get("in"),
-                              geometry_only=geometry_only)
-    plans_send = _chained_plans(src_of_send, m_in=m_pairs,
-                                progress=progress,
-                                cr_floors=floors.get("send"),
-                                geometry_only=geometry_only)
-    plans_recv = _chained_plans(src_of_recv,
-                                m_in=m_pairs + slab_pairs,
-                                progress=progress,
-                                cr_floors=floors.get("recv"),
-                                geometry_only=geometry_only)
-    src_out = np.full(2 * local, -1, np.int64)
-    has = degree > 0
-    pos_of_row = np.full(local, -1, np.int64)
-    pos_of_row[order] = pos
-    src_out[:local][has] = 2 * pos_of_row[has]
-    src_out[local:][has] = 2 * pos_of_row[has] + 1
-    plans_out = _chained_plans(src_out, m_in=2 * nu, progress=progress,
-                               unit=1, cr_floors=floors.get("out"),
-                               geometry_only=geometry_only)
+    out: dict = {}
+    if "in" in groups:
+        src_in = np.full(2 * nu, -1, np.int64)
+        src_in[2 * pos] = order
+        src_in[2 * pos + 1] = local + order
+        out["in"] = _chained_plans(src_in, m_in=2 * local,
+                                   progress=progress, unit=1,
+                                   cr_floors=floors.get("in"),
+                                   geometry_only=geometry_only)
+    if "send" in groups:
+        # plan_send: e1 -> [f_local | slab] (see the design note above)
+        src_of_send = np.full(m_pairs + slab_pairs, -1, np.int64)
+        src_of_send[slot[p1]] = slot[p2]
+        src_of_send[m_pairs + d_sorted * block_pairs + rank_in_block] = \
+            slot[po]
+        out["send"] = _chained_plans(src_of_send, m_in=m_pairs,
+                                     progress=progress,
+                                     cr_floors=floors.get("send"),
+                                     geometry_only=geometry_only)
+    if "recv" in groups:
+        # plan_recv: [f_local | incoming] -> f. Local-edge f slots read
+        # their own position in part 1; cross-edge f slots read their
+        # incoming block slot. The same entries read as in-edges
+        # nbr->row come from source shard nbr//local, and within a
+        # block the sender's (target, source) order is our (row, nbr)
+        # order — the CSR enumeration order — so a stable sort by
+        # source shard reproduces the sender's block layout
+        pr = xi[np.argsort(nbr_shard[xi], kind="stable")]
+        s_sorted = nbr_shard[pr]
+        starts_r = np.r_[0, np.flatnonzero(np.diff(s_sorted)) + 1]
+        lens_r = np.diff(np.r_[starts_r, len(s_sorted)])
+        rank_r = (np.arange(len(pr), dtype=np.int64)
+                  - np.repeat(starts_r, lens_r))
+        src_of_recv = np.full(m_pairs, -1, np.int64)
+        src_of_recv[slot[p1]] = slot[p1]
+        src_of_recv[slot[pr]] = (m_pairs + s_sorted * block_pairs
+                                 + rank_r)
+        out["recv"] = _chained_plans(src_of_recv,
+                                     m_in=m_pairs + slab_pairs,
+                                     progress=progress,
+                                     cr_floors=floors.get("recv"),
+                                     geometry_only=geometry_only)
+    if "out" in groups:
+        src_out = np.full(2 * local, -1, np.int64)
+        has = degree > 0
+        pos_of_row = np.full(local, -1, np.int64)
+        pos_of_row[order] = pos
+        src_out[:local][has] = 2 * pos_of_row[has]
+        src_out[local:][has] = 2 * pos_of_row[has] + 1
+        out["out"] = _chained_plans(src_out, m_in=2 * nu,
+                                    progress=progress, unit=1,
+                                    cr_floors=floors.get("out"),
+                                    geometry_only=geometry_only)
 
     if geometry_only:
-        return {"in": plans_in, "send": plans_send,
-                "recv": plans_recv, "out": plans_out}
+        return out
+    plans_in, plans_send = out["in"], out["send"]
+    plans_recv, plans_out = out["recv"], out["out"]
 
     return ShardPushDelivery(
         n=n, local_n=local, num_shards=num_shards, nu=nu,
@@ -700,7 +916,8 @@ def push_program_geometry(sd: ShardPushDelivery):
 
 
 def _build_push_shards(topo: Topology, n_padded: int, num_shards: int,
-                       progress=None) -> list:
+                       progress=None,
+                       build_workers: Optional[int] = None) -> list:
     """Uniformized per-shard push builds (capacity/block pre-pass +
     cr-floors fixpoint), one :class:`ShardPushDelivery` per shard, not
     yet stacked — exposed separately so tests can compare the shards'
@@ -742,53 +959,46 @@ def _build_push_shards(topo: Topology, n_padded: int, num_shards: int,
     assert_push_tables_linear(m_pairs_u, num_shards, block_pairs,
                               e_max, local, len(caps))
 
-    # cr-floors fixpoint, same reasoning as build_shard_deliveries
-    groups = ("in", "send", "recv", "out")
-    cr_floors = None
-    while True:
-        cr_max: dict = {}
-        for k in range(num_shards):
-            geo = build_shard_push_delivery(
-                topo, n_padded, num_shards, k, caps=caps,
-                block_pairs=block_pairs, cr_floors=cr_floors,
-                geometry_only=True)
-            for group, pair in geo.items():
-                for pi, plan in enumerate(pair):
-                    crs = tuple(st.cr for st in plan.stages)
-                    key = (group, pi)
-                    prev = cr_max.get(key, (0,) * len(crs))
-                    if len(prev) != len(crs):
-                        raise AssertionError(
-                            "per-shard stage counts diverged (uniform m "
-                            "should fix them — compiler bug)")
-                    cr_max[key] = tuple(
-                        max(a, b) for a, b in zip(prev, crs))
-        floors_now = {
-            g: (cr_max[(g, 0)], cr_max[(g, 1)]) for g in groups
-        }
-        if floors_now == cr_floors:
-            break
-        cr_floors = floors_now
-
-    shards = []
-    for k in range(num_shards):
-        shards.append(build_shard_push_delivery(
-            topo, n_padded, num_shards, k, caps=caps,
-            block_pairs=block_pairs, cr_floors=cr_floors,
-            progress=progress))
+    # cr-floors fixpoint (incremental) + parallel heavy builds, same
+    # machinery as build_shard_deliveries
+    workers = resolve_build_workers(build_workers, num_shards)
+    pool = _ShardBuildPool(
+        workers,
+        {"kind": "push", "topo": topo, "n_padded": n_padded,
+         "num_shards": num_shards, "caps": caps,
+         "block_pairs": block_pairs},
+        progress=progress)
+    try:
+        cr_floors = _uniform_cr_fixpoint(
+            ("in", "send", "recv", "out"), num_shards, pool,
+            progress=progress)
+        t0 = time.perf_counter()
+        shards = pool.run([("full", k, None, cr_floors)
+                           for k in range(num_shards)])
+        if progress:
+            progress(f"routed {num_shards} push shard plans in "
+                     f"{time.perf_counter() - t0:.1f}s "
+                     f"({workers} workers)")
+    finally:
+        pool.close()
     return shards
 
 
 def build_shard_push_deliveries(topo: Topology, n_padded: int,
                                 num_shards: int,
-                                progress=None) -> ShardPushDelivery:
+                                progress=None,
+                                build_workers: Optional[int] = None,
+                                ) -> ShardPushDelivery:
     """All shards' push deliveries, geometry-uniform, leaves stacked on
     a leading shard axis (same layout contract as
     :func:`build_shard_deliveries`). Unlike the pull builder this does
     NO whole-graph work per shard — the pre-pass and each shard's build
-    touch only that shard's CSR slice."""
+    touch only that shard's CSR slice. ``build_workers``: processes for
+    the per-shard compiles (default ``min(S, cpu_count)``); the output
+    is bitwise-independent of it."""
     shards = _build_push_shards(topo, n_padded, num_shards,
-                                progress=progress)
+                                progress=progress,
+                                build_workers=build_workers)
 
     g0 = push_program_geometry(shards[0])
     for k, sd in enumerate(shards[1:], 1):

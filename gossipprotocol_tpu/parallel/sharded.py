@@ -380,10 +380,12 @@ def make_sharded_chunk_runner(
 
         if cfg.routed_design == "push":
             nbrs, _ = plancache.shard_push_deliveries_cached(
-                topo, n_padded, num_shards, cache_dir=cfg.plan_cache)
+                topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
+                build_workers=cfg.build_workers)
         else:
             nbrs, _ = plancache.shard_deliveries_cached(
-                topo, n_padded, num_shards, cache_dir=cfg.plan_cache)
+                topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
+                build_workers=cfg.build_workers)
         nbrs_sharded = True  # leading shard axis splits over the mesh
     elif is_pushsum and cfg.fanout == "all":
         # every leaf of the edge pytree is built as equal per-device

@@ -23,6 +23,7 @@
 //     color  : int32[T * n * deg]  out, in [0, deg)
 //   returns 0 on success, nonzero on malformed input.
 
+#include <algorithm>
 #include <cstdint>
 #include <vector>
 
@@ -236,4 +237,133 @@ extern "C" int64_t route_tiles_full(int64_t T, int32_t unit,
     }
   }
   return err;
+}
+
+// Worker-process OpenMP clamp: the shard-build pool forks W workers
+// that would each inherit the parent's thread count and oversubscribe
+// the host; each worker calls this once with cpu_count/W.  Thread count
+// never changes results (all parallel writes here are disjoint and the
+// reductions are exact integer max/or/sum).
+extern "C" void set_native_threads(int32_t n) {
+#if defined(_OPENMP)
+  if (n > 0) omp_set_num_threads(n);
+#else
+  (void)n;
+#endif
+}
+
+// Stage-planner hot loops for the radix compiler (ops/plan.py).  The
+// numpy spelling spent each stage in an O(F log F) combined-key argsort
+// plus fancy-indexed scatters; these two entry points replace it with a
+// counting pass over the inverse position map (O(F + slots), parallel
+// over tiles) and a fused placement pass (parallel over flows).  Both
+// are exact mirrors of the numpy fallback — the plans must come out
+// bitwise identical either way (asserted in tests/test_native.py).
+//
+//   plan_stage_count(F, t_grid, u, b, pos, bucket, rank, max_run)
+//     F      : flows
+//     t_grid : tiles in the current layout (every pos < t_grid * u)
+//     u      : unit slots per tile
+//     b      : buckets (radix) at this stage
+//     pos    : int64[F]  current unit positions, distinct
+//     bucket : int32[F]  destination bucket per flow, in [0, b)
+//     rank   : int32[F]  out — rank of each flow within its
+//              (tile, bucket) run, counted in ascending-pos order.
+//              A tile's slots are contiguous in pos space, so scanning
+//              slots ascending within each tile assigns exactly the
+//              order numpy's stable argsort by (tile*b + bucket, pos)
+//              does.
+//     max_run: int64 out — longest run, in units
+//   returns 0 on success, 1 on out-of-range input, 2 on duplicate pos.
+extern "C" int64_t plan_stage_count(int64_t F, int64_t t_grid, int32_t u,
+                                    int32_t b, const int64_t* pos,
+                                    const int32_t* bucket, int32_t* rank,
+                                    int64_t* max_run) {
+  if (u <= 0 || b <= 0 || t_grid < 0 || F < 0) return 1;
+  const int64_t slots = t_grid * u;
+  std::vector<int64_t> inv(slots);
+  int64_t err = 0;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t s = 0; s < slots; ++s) inv[s] = -1;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static) reduction(| : err)
+#endif
+  for (int64_t f = 0; f < F; ++f) {
+    const int64_t p = pos[f];
+    if (p < 0 || p >= slots || bucket[f] < 0 || bucket[f] >= b) {
+      err = 1;
+      continue;
+    }
+    inv[p] = f;  // duplicates overwrite; caught by the seen-count below
+  }
+  if (err) return err;
+  int64_t mx = 0, seen = 0;
+#if defined(_OPENMP)
+#pragma omp parallel reduction(max : mx) reduction(+ : seen)
+#endif
+  {
+    std::vector<int32_t> cnt(b);
+#if defined(_OPENMP)
+#pragma omp for schedule(static)
+#endif
+    for (int64_t t = 0; t < t_grid; ++t) {
+      std::fill(cnt.begin(), cnt.end(), 0);
+      const int64_t base = t * u;
+      for (int64_t s = 0; s < u; ++s) {
+        const int64_t f = inv[base + s];
+        if (f < 0) continue;
+        rank[f] = cnt[bucket[f]]++;
+        ++seen;
+      }
+      for (int32_t k = 0; k < b; ++k) {
+        if (cnt[k] > mx) mx = cnt[k];
+      }
+    }
+  }
+  if (seen != F) return 2;
+  *max_run = mx;
+  return 0;
+}
+
+//   plan_stage_place(F, u, unit, b, cr, o, tau_in, tau_slab,
+//                    pos, bucket, rank, new_pos, perm)
+//     new_pos: int64[F] out — each flow's position in the staging slab
+//     perm   : int64[t_grid * o * u] or null — per-(tile, o) output-slot
+//              permutation, caller pre-filled with -1 (null skips it:
+//              the geometry-only passes need new_pos alone).  Every
+//              flow writes a distinct perm slot (distinct (bucket,
+//              rank) within a tile), so the flow loop is race-free.
+//   returns 0 on success, nonzero on malformed geometry.
+extern "C" int64_t plan_stage_place(int64_t F, int32_t u, int32_t unit,
+                                    int32_t b, int32_t cr, int32_t o,
+                                    int32_t tau_in, int32_t tau_slab,
+                                    const int64_t* pos,
+                                    const int32_t* bucket,
+                                    const int32_t* rank, int64_t* new_pos,
+                                    int64_t* perm) {
+  if (u <= 0 || unit <= 0 || 128 % unit != 0 || cr <= 0 || tau_in <= 0 ||
+      b <= 0 || o <= 0 || tau_slab <= 0) {
+    return 1;
+  }
+  const int32_t upr = 128 / unit;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t f = 0; f < F; ++f) {
+    const int64_t tile = pos[f] / u;
+    const int32_t rr = rank[f] / upr;
+    const int32_t rm = rank[f] % upr;
+    const int64_t reg = tile / tau_in;
+    const int64_t tir = tile - reg * tau_in;
+    new_pos[f] =
+        (((reg * b + bucket[f]) * tau_slab + tir) * cr + rr) * upr + rm;
+    if (perm) {
+      const int64_t out_slot =
+          (static_cast<int64_t>(bucket[f]) * cr + rr) * upr + rm;
+      perm[tile * o * u + out_slot] = pos[f] % u;
+    }
+  }
+  return 0;
 }

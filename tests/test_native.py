@@ -33,7 +33,9 @@ def _numpy_only():
         saved = dict(native._libs)
         native._libs[native._LIB_PATH] = None
         native._libs[native._ASYNC_LIB_PATH] = None
+        native._libs[native._ROUTE_LIB_PATH] = None
         assert not native.available(), "numpy-only patch did not take"
+        assert not native.routecolor_available()
         try:
             yield
         finally:
@@ -89,3 +91,84 @@ def test_power_law_native_path_valid(native_lib):
     assert t.degree.min() >= 1
     deg = np.sort(t.degree)[::-1]
     assert deg[0] > 5 * deg.mean()
+
+
+def _random_stage(rng, t_grid, u, b, fill):
+    """A random stage occupancy: ``fill`` of the t_grid*u unit slots
+    hold a flow (distinct pos), each with a random target bucket."""
+    pos = rng.choice(t_grid * u, size=fill, replace=False).astype(np.int64)
+    bucket = rng.integers(0, b, size=fill).astype(np.int64)
+    return pos, bucket
+
+
+def test_plan_stage_pack_matches_numpy(native_lib):
+    """The native counting pass must assign bitwise the ranks of the
+    fallback's stable argsort (the contiguous-slots argument in
+    ops/plan.py:_pack_stage), including the max-run measurement that
+    decides stage geometry."""
+    from gossipprotocol_tpu.ops.plan import _pack_stage
+
+    rng = np.random.default_rng(11)
+    for t_grid, u, b, fill in [(48, 64, 8, 1500), (6, 16, 2, 96),
+                               (128, 64, 16, 8192), (4, 8, 4, 0)]:
+        pos, bucket = _random_stage(rng, t_grid, u, b, fill)
+        assert native.plan_stage_pack(pos, bucket, u, b, t_grid) is not None
+        rank_c, mx_c = _pack_stage(pos, bucket, u, b, t_grid)
+        with _numpy_only():
+            rank_np, mx_np = _pack_stage(pos, bucket, u, b, t_grid)
+        assert mx_c == mx_np, (t_grid, u, b, fill)
+        np.testing.assert_array_equal(rank_c, rank_np)
+
+
+def test_plan_stage_place_matches_numpy(native_lib):
+    """The fused placement pass: staging-slab positions AND the scattered
+    output permutation must be bitwise the numpy mirror's."""
+    from gossipprotocol_tpu.ops.plan import _pack_stage, _place_stage
+
+    rng = np.random.default_rng(12)
+    # geometry invariant: a tile holds u = 128 * (128 // unit) unit
+    # slots — perm rows are [o, u] bijection fragments of real tiles
+    for unit, b, tau_in, p_regions, fill in [
+            (2, 8, 2, 2, 600),      # cr == 1: sparse runs
+            (2, 2, 2, 2, 4000),     # cr > 1: runs overflow one row
+            (4, 16, 1, 3, 900)]:
+        upr = 128 // unit
+        u = 128 * upr
+        t_grid = p_regions * tau_in
+        pos, bucket = _random_stage(rng, t_grid, u, b, fill)
+        rank, max_run = _pack_stage(pos, bucket, u, b, t_grid)
+        cr = 1
+        while cr < -(-max_run // upr) and cr < 128:
+            cr *= 2
+        o = -(-b * cr // 128)
+        tau_slab = -(-(tau_in * cr) // 128) * (128 // cr)
+
+        perm_c = np.full((t_grid * o, u), -1, np.int64)
+        new_c = _place_stage(pos, bucket, rank, u, unit, b, cr, o,
+                             tau_in, tau_slab, perm=perm_c)
+        geo_c = _place_stage(pos, bucket, rank, u, unit, b, cr, o,
+                             tau_in, tau_slab)  # geometry-only path
+        with _numpy_only():
+            perm_np = np.full((t_grid * o, u), -1, np.int64)
+            new_np = _place_stage(pos, bucket, rank, u, unit, b, cr, o,
+                                  tau_in, tau_slab, perm=perm_np)
+        np.testing.assert_array_equal(new_c, new_np)
+        np.testing.assert_array_equal(geo_c, new_np)
+        np.testing.assert_array_equal(perm_c, perm_np)
+
+
+def test_native_threads_clamp_is_inert(native_lib):
+    """set_native_threads bounds OpenMP parallelism (the worker-pool
+    anti-oversubscription clamp) without changing any kernel output."""
+    from gossipprotocol_tpu.ops.plan import _pack_stage
+
+    rng = np.random.default_rng(13)
+    pos, bucket = _random_stage(rng, 96, 64, 8, 4000)
+    ref = _pack_stage(pos, bucket, 64, 8, 96)
+    try:
+        native.set_native_threads(1)
+        one = _pack_stage(pos, bucket, 64, 8, 96)
+    finally:
+        native.set_native_threads(os.cpu_count() or 1)
+    assert ref[1] == one[1]
+    np.testing.assert_array_equal(ref[0], one[0])

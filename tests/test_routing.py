@@ -271,6 +271,75 @@ def test_plan_build_rate_floor():
         f"({topo.num_directed_edges} edges in {dt:.1f}s)")
 
 
+def _hash_plan_tree(tree) -> str:
+    """Order-stable digest of every packed array in a plan pytree."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    leaves, _ = jax.tree.flatten(tree)
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a))
+    return h.hexdigest()
+
+
+def test_shard_build_worker_count_invariant():
+    """Plans built with --build-workers 1 vs 4 must be bitwise-equal —
+    the pool merges per-shard results in shard order and the builder
+    holds no wall-clock or PRNG state, so worker count is purely a
+    wall-time knob (this is also what lets the plan cache ignore it)."""
+    from gossipprotocol_tpu.ops.sharddelivery import (
+        build_shard_deliveries, build_shard_push_deliveries,
+    )
+    from gossipprotocol_tpu.parallel.sharded import padded_size
+
+    topo = build_topology("powerlaw", 500, seed=7, m=3)
+    p = padded_size(500, 8)
+    for build in (build_shard_push_deliveries, build_shard_deliveries):
+        h1 = _hash_plan_tree(build(topo, p, 8, build_workers=1))
+        h4 = _hash_plan_tree(build(topo, p, 8, build_workers=4))
+        assert h1 == h4, f"{build.__name__}: workers=1 {h1} != workers=4 {h4}"
+
+
+def test_shard_build_within_single_chip_budget():
+    """The 8-shard build must cost <= 1.2x a single-chip build of the
+    same graph: per shard when serialized (the slope a worker pool
+    converts into wall time — incremental fixpoint + one heavy routing
+    pass per shard keep it flat), and in wall time outright when the
+    host has a core per shard."""
+    import os
+    import time
+
+    from gossipprotocol_tpu.ops.sharddelivery import (
+        build_shard_push_deliveries,
+    )
+    from gossipprotocol_tpu.parallel.sharded import padded_size
+
+    topo = build_topology("powerlaw", 20_000, seed=5, m=4)
+    t0 = time.perf_counter()
+    build_routed_delivery(topo, device=False)
+    single_s = time.perf_counter() - t0
+
+    p = padded_size(topo.num_nodes, 8)
+    t0 = time.perf_counter()
+    build_shard_push_deliveries(topo, p, 8, build_workers=1)
+    serial_s = time.perf_counter() - t0
+    per_shard = serial_s / 8
+    assert per_shard <= 1.2 * single_s, (
+        f"per-shard build {per_shard:.2f}s exceeds 1.2x single-chip "
+        f"{single_s:.2f}s (serial 8-shard total {serial_s:.2f}s)")
+
+    if (os.cpu_count() or 1) >= 8:
+        t0 = time.perf_counter()
+        build_shard_push_deliveries(topo, p, 8, build_workers=8)
+        wall_s = time.perf_counter() - t0
+        assert wall_s <= 1.2 * single_s, (
+            f"8-worker 8-shard build {wall_s:.2f}s exceeds 1.2x "
+            f"single-chip {single_s:.2f}s")
+
+
 def test_routed_config_validation():
     with pytest.raises(ValueError, match="fanout-all"):
         RunConfig(algorithm="push-sum", fanout="one", delivery="routed")
