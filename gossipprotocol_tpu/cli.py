@@ -21,6 +21,7 @@ inversion), ``--metrics-out``, ``--checkpoint-dir``, ``--resume``,
 ``--fail-fraction/--fail-round``, ``--revive-round`` (churn),
 ``--drop-prob/--drop-window`` (mass-conserving message loss),
 ``--fault-plan`` (declarative JSON fault schedule),
+``--repair`` (self-healing topology repair under churn),
 ``--devices`` (multi-chip sharding),
 ``--ws-k/--ws-beta`` (small-world knobs), ``--profile-dir``. Invalid
 input errors loudly — the reference silently
@@ -82,6 +83,7 @@ def _build_config(args, algo, fault_schedule, jnp, alert_quorum=None):
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
         fault_schedule=fault_schedule,
+        repair=args.repair,
     )
 
 
@@ -347,6 +349,18 @@ def build_parser() -> argparse.ArgumentParser:
                         '"revive": [{"round": R, "ids": [...]}], '
                         '"loss": [{"start": A, "stop": B, "prob": P}]}. '
                         "Merged with the --fail-*/--revive-*/--drop-* sugar")
+    p.add_argument("--repair", choices=["off", "prune", "rewire"],
+                   default="off",
+                   help="self-healing topology repair at fault events. "
+                        "'prune' drops dead endpoints from the adjacency "
+                        "(the majority-partition rule still applies, with "
+                        "identical victims); 'rewire' additionally splices "
+                        "the orphaned endpoints of dead nodes to each "
+                        "other deterministically from --seed (degree-"
+                        "preserving; leftovers draw a random live peer), "
+                        "so previously-stranded survivors stay in the "
+                        "computation. Repair never touches protocol state "
+                        "— push-sum mass is conserved across every rewire")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="emit a jax.profiler trace here")
     p.add_argument("--compile-cache", type=str,
@@ -535,15 +549,48 @@ def main(argv=None) -> int:
         print(str(e), file=sys.stderr)
         return 2
 
+    if (args.auto_resume > 0 and not args.resume
+            and not (args.checkpoint_every and args.checkpoint_dir)):
+        # RunConfig warns about the half-configured pair; this is the
+        # recovery-specific consequence the user asked for with -N
+        print(
+            "warning: --auto-resume has no usable checkpoint config "
+            "(need both --checkpoint-dir and --checkpoint-every) — a "
+            "recovery will RESTART FROM SCRATCH",
+            file=sys.stderr,
+        )
+
     state = None
     if args.resume:
-        path = args.resume
-        if os.path.isdir(path):
-            path = ckpt.latest(path)
-            if path is None:
-                print(f"no checkpoint found in {args.resume}", file=sys.stderr)
-                return 2
-        state, meta = ckpt.load(path)
+        # fallback chain: a *published* checkpoint can still be unreadable
+        # (bitrot, or a torn write on a filesystem where rename is not
+        # atomic) — walk the directory's candidates newest-first and fall
+        # back to the previous published checkpoint instead of dying on
+        # the newest. An explicit file path gets no fallback: the user
+        # named that exact checkpoint.
+        import zipfile
+
+        cands = (ckpt.candidates(args.resume)
+                 if os.path.isdir(args.resume) else [args.resume])
+        if not cands:
+            print(f"no checkpoint found in {args.resume}", file=sys.stderr)
+            return 2
+        state = meta = None
+        for path in cands:
+            try:
+                ckpt.peek_meta(path)  # cheap probe before the full load
+                state, meta = ckpt.load(path)
+                break
+            except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+                print(
+                    f"warning: checkpoint {path} unreadable "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "previous published checkpoint",
+                    file=sys.stderr,
+                )
+        if state is None:
+            print(f"no readable checkpoint in {args.resume}", file=sys.stderr)
+            return 2
         # a checkpoint from a different experiment would "resume" into a
         # plausible-but-wrong run — validate before continuing (and before
         # anything with side effects, like opening the metrics file).
@@ -668,21 +715,26 @@ def main(argv=None) -> int:
         def _round_of(path_or_dir):
             if not path_or_dir:
                 return None
-            path = path_or_dir
-            if os.path.isdir(path):
-                path = ckpt.latest(path)
-            if path is None or not os.path.exists(path):
-                return None
-            try:
-                m = ckpt.peek_meta(path)
-            except Exception:
-                return None  # published ckpts are atomic; treat junk as absent
-            compatible = (
-                all(ckpt.field_matches(m, k, v) for k, v in traj.items())
-                and m.get("topology") in (None, topo.kind)
-                and m.get("adjacency") in (None, fp)
-            )
-            return int(m.get("round", -1)) if compatible else None
+            paths = (ckpt.candidates(path_or_dir)
+                     if os.path.isdir(path_or_dir) else [path_or_dir])
+            for path in paths:
+                if not os.path.exists(path):
+                    continue
+                try:
+                    m = ckpt.peek_meta(path)
+                except Exception:
+                    # unreadable (torn write/bitrot) — fall back to the
+                    # previous published candidate, like the resume block
+                    continue
+                compatible = (
+                    all(ckpt.field_matches(m, k, v) for k, v in traj.items())
+                    and m.get("topology") in (None, topo.kind)
+                    and m.get("adjacency") in (None, fp)
+                )
+                # the first READABLE candidate decides: an incompatible
+                # one means this target holds a different experiment
+                return int(m.get("round", -1)) if compatible else None
+            return None
 
         candidates = [
             (r, target)

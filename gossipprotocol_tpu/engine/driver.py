@@ -127,6 +127,14 @@ class RunConfig:
     fault_schedule: Optional[Any] = None  # faults.FaultSchedule: timed
                                    # kill/revive strikes + link-loss
                                    # windows (utils/faults.py)
+    repair: str = "off"            # overlay self-healing at strike
+                                   # rounds: "off" | "prune" (drop dead
+                                   # endpoints from the CSR) | "rewire"
+                                   # (prune + deterministic degree-
+                                   # preserving splice of survivors;
+                                   # topology/repair.py). Trajectory
+                                   # field: the policy rewrites the
+                                   # adjacency mid-run
 
     @property
     def schedule(self):
@@ -182,6 +190,31 @@ class RunConfig:
             raise ValueError("delivery must be 'scatter', 'invert', or "
                              "'routed'")
         sched = self.schedule.validate()  # structural check, loud + early
+        from gossipprotocol_tpu.topology.repair import validate_policy
+
+        validate_policy(self.repair)
+        if self.repair != "off" and self.semantics == "reference":
+            raise ValueError(
+                "repair applies to faulted runs; semantics='reference' "
+                "rejects fault schedules entirely, so there is nothing "
+                "to repair"
+            )
+        # half-configured checkpointing silently disables itself in the
+        # drive loop (checkpointing = every AND dir); that silence has
+        # cost users their recovery story, so be loud at config time
+        if bool(self.checkpoint_every) != bool(self.checkpoint_dir):
+            import warnings
+
+            missing = ("checkpoint_dir" if self.checkpoint_every
+                       else "checkpoint_every")
+            given = ("checkpoint_every" if self.checkpoint_every
+                     else "checkpoint_dir")
+            warnings.warn(
+                f"checkpointing is DISABLED: {given} is set but {missing} "
+                "is not — both are required, no checkpoint will be "
+                "written this run",
+                stacklevel=2,
+            )
         if self.delivery == "routed":
             if self.algorithm != "push-sum" or self.fanout != "all":
                 raise ValueError(
@@ -772,6 +805,19 @@ def revive_rows(state, ids, cfg: RunConfig, num_nodes: int):
     )
 
 
+def _mass_snapshot(state):
+    """(Σs, Σw) over every row as float64 host sums — the invariant a
+    repair rebuild must preserve bitwise. None for mass-free states
+    (gossip counts hits, it has no conserved quantity)."""
+    if not hasattr(state, "s"):
+        return None
+    from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
+
+    host = ckpt_mod.fetch_host((state.s, state.w))
+    return (float(np.asarray(host[0], np.float64).sum()),
+            float(np.asarray(host[1], np.float64).sum()))
+
+
 def _drive(
     topo: Topology,
     cfg: RunConfig,
@@ -780,6 +826,8 @@ def _drive(
     done_fn,
     compile_ms: float,
     trim: Callable[[Any], Any] = lambda s: s,
+    rebuild: Optional[Callable] = None,
+    run_topo: Optional[Topology] = None,
 ) -> RunResult:
     """Shared host loop for the single-chip and sharded engines.
 
@@ -787,10 +835,18 @@ def _drive(
     device and returns on-device summary scalars (one host fetch per
     chunk); ``trim`` drops padding rows before anything user-visible
     (checkpoints, the returned final state).
+
+    ``rebuild(new_topo, state) -> (step, state, info)`` re-derives the
+    engine's device adjacency and compiled step for a repaired topology
+    (``cfg.repair != "off"``); ``info`` is a json-able dict merged into
+    the repair metrics record (plan-patch provenance). ``run_topo`` is
+    the adjacency actually in force at entry — the birth topology unless
+    a resume already replayed repair events past it.
     """
     from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
     from gossipprotocol_tpu.utils import faults as faults_mod
 
+    run_topo = run_topo if run_topo is not None else topo
     sched = cfg.schedule
     kills = {r: np.asarray(v, dtype=np.int64)
              for r, v in sched.kills.items()}
@@ -832,19 +888,41 @@ def _drive(
         if due_k or due_r:
             alive_host = np.array(ckpt_mod.fetch_host(state.alive))  # writable copy
             before = alive_host.copy()
+            req_revive = (np.concatenate([revives[r] for r in due_r])
+                          if due_r else np.empty(0, np.int64))
             for r in due_k:
                 alive_host[kills.pop(r)] = False
             for r in due_r:
                 alive_host[revives.pop(r)] = True
-            # unreachable-from-the-majority == failed: stranded survivors
-            # and fault-split minority components would hang the predicate
-            # forever (majority-partition semantics). Re-run after revives
-            # too: a returning node counts only once it is reattached to
-            # the majority component — otherwise it stays dead (and keeps
-            # its scheduled id; a later revive can still reattach it).
-            alive_host[: topo.num_nodes] = faults_mod.kill_disconnected(
-                topo, alive_host[: topo.num_nodes]
-            )
+            repair_stats = None
+            if cfg.repair == "off":
+                # unreachable-from-the-majority == failed: stranded
+                # survivors and fault-split minority components would hang
+                # the predicate forever (majority-partition semantics).
+                # Re-run after revives too: a returning node counts only
+                # once it is reattached to the majority component —
+                # otherwise it stays dead (and keeps its scheduled id; a
+                # later revive can still reattach it).
+                alive_host[: topo.num_nodes] = faults_mod.kill_disconnected(
+                    topo, alive_host[: topo.num_nodes]
+                )
+            else:
+                # self-healing (topology/repair.py): prune dead endpoints
+                # from the CSR (rewire additionally re-splices survivors),
+                # then the policy-conditional partition rule runs against
+                # the *repaired* adjacency — under rewire the splice has
+                # already reattached orphans, so stranded survivors stay
+                # in the computation instead of being executed
+                from gossipprotocol_tpu.topology import repair as repair_mod
+
+                run_topo, repair_stats = repair_mod.repair_topology(
+                    run_topo, alive_host[: topo.num_nodes], cfg.repair,
+                    run_seed=cfg.seed, event_round=cur_round,
+                    revived=req_revive,
+                )
+                alive_host[: topo.num_nodes] = faults_mod.apply_partition_rule(
+                    run_topo, alive_host[: topo.num_nodes], cfg.repair
+                )
             alive_host[topo.num_nodes:] = False  # padding rows never live
             # nodes that actually (re)joined — revive ids that survived
             # the majority rule — restart from fresh-born state
@@ -868,6 +946,43 @@ def _drive(
                 # the compiled step expects its input layout unchanged
                 alive_dev = jax.device_put(alive_dev, state.alive.sharding)
             state = state._replace(alive=alive_dev)
+
+            if repair_stats is not None:
+                info: dict = {}
+                rebuild_s = 0.0
+                if repair_stats["changed"]:
+                    if rebuild is None:
+                        raise RuntimeError(
+                            "repair event fired but the engine supplied "
+                            "no rebuild hook"
+                        )
+                    # repair must never touch protocol state: push-sum
+                    # mass over every row is conserved *exactly* across
+                    # the device rebuild (float64 host sums of the same
+                    # bits — any drift means the rebuild corrupted or
+                    # re-initialized a buffer)
+                    mass0 = _mass_snapshot(state)
+                    t0r = time.perf_counter()
+                    step, state, info = rebuild(run_topo, state)
+                    rebuild_s = time.perf_counter() - t0r
+                    mass1 = _mass_snapshot(state)
+                    if mass0 != mass1:
+                        raise AssertionError(
+                            f"repair rebuild changed protocol mass: "
+                            f"{mass0} -> {mass1} (policy={cfg.repair}, "
+                            f"round={cur_round})"
+                        )
+                rec = {
+                    "event": "repair",
+                    "round": cur_round,
+                    "policy": cfg.repair,
+                    "rebuild_s": rebuild_s,
+                    **{k: v for k, v in repair_stats.items()},
+                    **info,
+                }
+                metrics.append(rec)
+                if cfg.metrics_callback:
+                    cfg.metrics_callback(rec)
 
         next_event = min([*kills, *revives], default=cfg.max_rounds)
         round_limit = min(cur_round + chunk_rounds, cfg.max_rounds, next_event)
@@ -942,14 +1057,26 @@ def run_simulation(
 
     ``initial_state`` resumes from a checkpoint (SURVEY.md §5.4).
     """
+    run_topo = topo
+    if cfg.repair != "off" and initial_state is not None:
+        # a repair run's adjacency is a function of (birth topo, schedule,
+        # policy, seed): replay the strike rounds the checkpoint already
+        # lived through so the resumed run continues on the same repaired
+        # graph bitwise (topology/repair.py keys its rng per event round)
+        from gossipprotocol_tpu.topology import repair as repair_mod
+
+        start_round = int(np.asarray(jax.device_get(initial_state.round)))
+        run_topo = repair_mod.replay_repaired_topology(
+            topo, cfg.schedule, cfg.repair, cfg.seed, start_round
+        )
     state, round_core, done_fn, extra_stats, _ = build_protocol(
-        topo, cfg, allow_all_alive=resume_allows_fast(topo, initial_state)
+        run_topo, cfg, allow_all_alive=resume_allows_fast(topo, initial_state)
     )
     if initial_state is not None:
         # copy: the chunk runner donates its input buffers, and consuming
         # the caller's arrays in-place would be a surprising API
         state = jax.tree.map(jnp.array, initial_state)
-    nbrs = device_arrays(topo, cfg)
+    nbrs = device_arrays(run_topo, cfg)
     base_key = jax.random.key(cfg.seed)
     runner = make_chunk_runner(round_core, done_fn, extra_stats)
 
@@ -962,7 +1089,29 @@ def run_simulation(
     state = warm_start(step, state)
     compile_ms = (time.perf_counter() - t0) * 1e3
 
-    return _drive(topo, cfg, state, step, done_fn, compile_ms)
+    def rebuild(new_topo, st):
+        # the repaired graph has new edge shapes: re-derive the round core
+        # (keep_alive / inversion eligibility can flip with the adjacency),
+        # rebuild the device neighbor arrays, recompile, and re-warm. The
+        # state pytree is shape-stable (num_nodes never changes), so the
+        # live buffers thread straight through.
+        t0p = time.perf_counter()
+        _, core2, done2, extra2, _ = build_protocol(
+            new_topo, cfg, allow_all_alive=False
+        )
+        nbrs2 = device_arrays(new_topo, cfg)
+        plan_patch_s = time.perf_counter() - t0p
+        runner2 = make_chunk_runner(core2, done2, extra2)
+        compiled2 = runner2.lower(st, nbrs2, base_key, jnp.int32(0)).compile()
+
+        def step2(s, round_limit):
+            return compiled2(s, nbrs2, base_key, jnp.int32(round_limit))
+
+        st = warm_start(step2, st)
+        return step2, st, {"plan_patch_s": plan_patch_s}
+
+    return _drive(topo, cfg, state, step, done_fn, compile_ms,
+                  rebuild=rebuild, run_topo=run_topo)
 
 
 def warm_start(step, state):

@@ -1014,6 +1014,153 @@ def build_shard_push_deliveries(topo: Topology, n_padded: int,
     ])
 
 
+def _push_shard_slices_equal(old_topo: Topology, new_topo: Topology,
+                             lo: int, hi_real: int) -> bool:
+    """Did shard [lo, hi_real)'s owned CSR slice survive a repair
+    unchanged? (Both the row pointers and the neighbor ids must match —
+    a shard whose rows kept their degrees but swapped a neighbor still
+    needs a rebuild.)"""
+    oo = np.asarray(old_topo.offsets, np.int64)
+    no = np.asarray(new_topo.offsets, np.int64)
+    if not np.array_equal(oo[lo: hi_real + 1] - oo[lo],
+                          no[lo: hi_real + 1] - no[lo]):
+        return False
+    return np.array_equal(
+        np.asarray(old_topo.indices)[oo[lo]: oo[hi_real]],
+        np.asarray(new_topo.indices)[no[lo]: no[hi_real]])
+
+
+def patch_shard_push_deliveries(old_topo: Topology, new_topo: Topology,
+                                stacked: ShardPushDelivery,
+                                n_padded: int, num_shards: int,
+                                build_workers: Optional[int] = None,
+                                progress=None):
+    """Incrementally patch stacked push plans for a repaired topology.
+
+    A repair event (topology/repair.py) usually touches a handful of
+    rows; only the shards whose owned CSR slice changed need the heavy
+    tile-routing pass. The patch forces the *old* geometry — recovered
+    class capacities, block capacity, and per-stage cr floors — onto the
+    changed shards and splices the rebuilt plans into the stacked
+    leaves. This is sound because the compiled trajectory is
+    capacity/floor-independent: shares are computed elementwise and each
+    node's reduce tree depends only on its degree class, so a patched
+    plan (old forced caps) delivers bitwise the same sums as a cold
+    build of the new topology would (tests/test_pushdelivery.py pins the
+    cap-independence).
+
+    Returns ``(patched_stacked, rebuilt_shard_count)``, or ``None`` when
+    the patch preconditions fail — the repaired census outgrew a forced
+    capacity, a block outgrew the slab, or a floor moved — and the
+    caller must fall back to a cold build. Patched plans must never be
+    written to the plan cache: a cold build of the same topology derives
+    *its* capacities from the new census and produces different tables.
+    """
+    if old_topo.num_nodes != new_topo.num_nodes:
+        raise ValueError("repair never changes the node count")
+    n = new_topo.num_nodes
+    local = n_padded // num_shards
+    changed = [
+        k for k in range(num_shards)
+        if not _push_shard_slices_equal(
+            old_topo, new_topo, k * local,
+            max(k * local, min(k * local + local, n)))
+    ]
+    if not changed:
+        return stacked, 0
+
+    # recover the forcing the original build committed to
+    caps = {int(c): int(cap) for c, _, _, _, cap in stacked.classes}
+    block_pairs = int(stacked.block_pairs)
+    groups = ("in", "send", "recv", "out")
+    old_floors = {
+        g: tuple(tuple(int(st.cr) for st in p.stages)
+                 for p in getattr(stacked, "plan_" + g))
+        for g in groups
+    }
+
+    # cheap precondition pass: the changed shards' new census must fit
+    # inside the forced geometry, else the program shapes would move
+    offsets = np.asarray(new_topo.offsets, np.int64)
+    indices = np.asarray(new_topo.indices, np.int64)
+    degree_full = np.diff(offsets)
+    for k in changed:
+        lo = k * local
+        hi_real = max(lo, min(lo + local, n))
+        cls = degree_classes(degree_full[lo:hi_real])
+        c_vals, counts = np.unique(cls[cls > 0], return_counts=True)
+        for c, cnt in zip(c_vals, counts):
+            if int(cnt) > caps.get(int(c), 0):
+                if progress:
+                    progress(f"plan patch: shard {k} class {int(c)} "
+                             f"count {int(cnt)} outgrew cap "
+                             f"{caps.get(int(c), 0)}; cold build")
+                return None
+        nbr = indices[offsets[lo]: offsets[hi_real]]
+        nbr_shard = nbr // local
+        cross = nbr_shard[nbr_shard != k]
+        if len(cross) and int(np.bincount(
+                cross, minlength=num_shards).max()) > block_pairs:
+            if progress:
+                progress(f"plan patch: shard {k} block census outgrew "
+                         f"{block_pairs}; cold build")
+            return None
+
+    ref_geo = push_program_geometry(
+        jax.tree.map(lambda x: x[0], stacked))
+    workers = resolve_build_workers(build_workers, len(changed))
+    pool = _ShardBuildPool(
+        workers,
+        {"kind": "push", "topo": new_topo, "n_padded": n_padded,
+         "num_shards": num_shards, "caps": caps,
+         "block_pairs": block_pairs},
+        progress=progress)
+    try:
+        # one geometry measurement under the old floors: if any changed
+        # shard wants a larger cr anywhere, the floors would have to move
+        # for EVERY shard (the shard_map single-program constraint) —
+        # that is a full rebuild, not a patch
+        geos = pool.run([("geo", k, groups, old_floors) for k in changed])
+        for k, geo in zip(changed, geos):
+            for g in groups:
+                crs = tuple(tuple(int(st.cr) for st in plan.stages)
+                            for plan in geo[g])
+                if crs != old_floors[g]:
+                    if progress:
+                        progress(f"plan patch: shard {k} group {g} cr "
+                                 "floors moved; cold build")
+                    return None
+        t0 = time.perf_counter()
+        rebuilt = pool.run([("full", k, None, old_floors)
+                            for k in changed])
+    except (AssertionError, ValueError) as e:
+        # e.g. a guard inside the builder the pre-pass did not predict;
+        # the cold path is always available
+        if progress:
+            progress(f"plan patch failed ({e}); cold build")
+        return None
+    finally:
+        pool.close()
+
+    for k, sd in zip(changed, rebuilt):
+        if push_program_geometry(sd) != ref_geo:
+            if progress:
+                progress(f"plan patch: shard {k} geometry diverged from "
+                         "the stacked program; cold build")
+            return None
+
+    leaves_stacked, treedef = jax.tree.flatten(stacked)
+    out_leaves = [np.array(lv) for lv in leaves_stacked]
+    for k, sd in zip(changed, rebuilt):
+        for i, lv in enumerate(jax.tree.flatten(sd)[0]):
+            out_leaves[i][k] = lv
+    if progress:
+        progress(f"plan patch: rebuilt {len(changed)}/{num_shards} "
+                 f"shards in {time.perf_counter() - t0:.1f}s "
+                 f"({workers} workers)")
+    return treedef.unflatten(out_leaves), len(changed)
+
+
 def pushsum_diffusion_round_routed_push(
     state,
     shard_rd: ShardPushDelivery,  # this device's slice (leading axis 1)

@@ -234,11 +234,18 @@ def pad_neighbors(nbrs, n_padded: int):
 
 
 def make_sharded_chunk_runner(
-    topo: Topology, cfg: RunConfig, mesh: Mesh, allow_all_alive: bool = True
+    topo: Topology, cfg: RunConfig, mesh: Mesh, allow_all_alive: bool = True,
+    nbrs_override=None,
 ):
     """jitted ``(state, nbrs, seed, round_limit) -> state`` advancing one
     chunk under shard_map. Returns (runner, initial padded+placed state,
-    placed nbrs, done_fn)."""
+    placed nbrs, done_fn).
+
+    ``nbrs_override``: pre-built routed shard deliveries to use instead
+    of the plan-cache path — the repair engine hands in incrementally
+    *patched* plans here (ops/sharddelivery.py), which must never reach
+    the cache: their capacities are forced to the pre-repair maxima, so
+    a cold build of the same topology would produce different tables."""
     n = topo.num_nodes
     num_shards = int(mesh.devices.size)
     n_padded = padded_size(n, num_shards)
@@ -376,16 +383,19 @@ def make_sharded_chunk_runner(
 
     specs = _state_specs(state0)
     if routed:
-        from gossipprotocol_tpu.ops import plancache
-
-        if cfg.routed_design == "push":
-            nbrs, _ = plancache.shard_push_deliveries_cached(
-                topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
-                build_workers=cfg.build_workers)
+        if nbrs_override is not None:
+            nbrs = nbrs_override
         else:
-            nbrs, _ = plancache.shard_deliveries_cached(
-                topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
-                build_workers=cfg.build_workers)
+            from gossipprotocol_tpu.ops import plancache
+
+            if cfg.routed_design == "push":
+                nbrs, _ = plancache.shard_push_deliveries_cached(
+                    topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
+                    build_workers=cfg.build_workers)
+            else:
+                nbrs, _ = plancache.shard_deliveries_cached(
+                    topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
+                    build_workers=cfg.build_workers)
         nbrs_sharded = True  # leading shard axis splits over the mesh
     elif is_pushsum and cfg.fanout == "all":
         # every leaf of the edge pytree is built as equal per-device
@@ -454,13 +464,39 @@ def run_simulation_sharded(
         devices = jax.devices(backend) if backend else None
         mesh = make_mesh(num_devices, devices=devices)
     n = topo.num_nodes
-    n_padded = padded_size(n, int(mesh.devices.size))
+    num_shards = int(mesh.devices.size)
+    n_padded = padded_size(n, num_shards)
 
     from gossipprotocol_tpu.engine.driver import resume_allows_fast
 
+    run_topo = topo
+    if cfg.repair != "off" and initial_state is not None:
+        # same replay the single-chip engine does: the resumed run must
+        # continue on the repaired adjacency the checkpoint lived through
+        from gossipprotocol_tpu.topology import repair as repair_mod
+
+        start_round = int(np.asarray(jax.device_get(initial_state.round)))
+        run_topo = repair_mod.replay_repaired_topology(
+            topo, cfg.schedule, cfg.repair, cfg.seed, start_round
+        )
+
+    is_pushsum = cfg.algorithm != "gossip"
+    routed = is_pushsum and cfg.fanout == "all" and cfg.delivery == "routed"
+    routed_push = routed and cfg.routed_design == "push"
+    # for routed-push repair runs, hold the host-side stacked plans: the
+    # incremental patcher splices rebuilt shards into them at repair events
+    plans_host = None
+    if routed_push:
+        from gossipprotocol_tpu.ops import plancache
+
+        plans_host, _ = plancache.shard_push_deliveries_cached(
+            run_topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
+            build_workers=cfg.build_workers)
+
     runner, state, nbrs, done_fn, shardings = make_sharded_chunk_runner(
-        topo, cfg, mesh,
+        run_topo, cfg, mesh,
         allow_all_alive=resume_allows_fast(topo, initial_state),
+        nbrs_override=plans_host,
     )
     if initial_state is not None:
         # copy before placing: device_put of host numpy arrays is
@@ -483,4 +519,55 @@ def run_simulation_sharded(
     def trim(s):
         return jax.tree.map(lambda x: x[:n] if jnp.ndim(x) >= 1 else x, s)
 
-    return _drive(topo, cfg, state, step, done_fn, compile_ms, trim=trim)
+    cur = {"topo": run_topo, "plans": plans_host}
+
+    def rebuild(new_topo, st):
+        # repair-event rebuild: patch the routed plans incrementally when
+        # possible (only the shards whose owned CSR slice changed pay the
+        # heavy routing pass), re-derive the shard_map program, recompile,
+        # re-warm. State shapes/shardings are stable (n_padded fixed).
+        info: dict = {}
+        nbrs_over = None
+        if routed:
+            from gossipprotocol_tpu.ops import sharddelivery as sd
+
+            t0p = time.perf_counter()
+            if routed_push and cur["plans"] is not None:
+                patched = sd.patch_shard_push_deliveries(
+                    cur["topo"], new_topo, cur["plans"], n_padded,
+                    num_shards, build_workers=cfg.build_workers)
+                if patched is not None:
+                    nbrs_over, rebuilt = patched
+                    info = {"plan_patch": "incremental",
+                            "plan_shards_rebuilt": int(rebuilt)}
+            if nbrs_over is None:
+                # pull design, or the patch preconditions failed (the
+                # repaired census outgrew the forced capacities): cold
+                # build, bypassing the cache — per-event topologies
+                # would bloat it for a one-shot use
+                if routed_push:
+                    nbrs_over = sd.build_shard_push_deliveries(
+                        new_topo, n_padded, num_shards,
+                        build_workers=cfg.build_workers)
+                else:
+                    nbrs_over = sd.build_shard_deliveries(
+                        new_topo, n_padded, num_shards,
+                        build_workers=cfg.build_workers)
+                info = {"plan_patch": "cold",
+                        "plan_shards_rebuilt": num_shards}
+            info["plan_patch_s"] = time.perf_counter() - t0p
+        runner2, _, nbrs2, _, _ = make_sharded_chunk_runner(
+            new_topo, cfg, mesh, allow_all_alive=False,
+            nbrs_override=nbrs_over,
+        )
+        compiled2 = runner2.lower(st, nbrs2, seed, jnp.int32(0)).compile()
+
+        def step2(s, round_limit):
+            return compiled2(s, nbrs2, seed, jnp.int32(round_limit))
+
+        st = warm_start(step2, st)
+        cur["topo"], cur["plans"] = new_topo, nbrs_over if routed_push else None
+        return step2, st, info
+
+    return _drive(topo, cfg, state, step, done_fn, compile_ms, trim=trim,
+                  rebuild=rebuild, run_topo=run_topo)

@@ -13,10 +13,18 @@ from gossipprotocol_tpu.topology.registry import (
     available_topologies,
     register_topology,
 )
+from gossipprotocol_tpu.topology.repair import (
+    REPAIR_POLICIES,
+    repair_topology,
+    replay_repaired_topology,
+)
 
 __all__ = [
     "Topology",
     "csr_from_edges",
+    "REPAIR_POLICIES",
+    "repair_topology",
+    "replay_repaired_topology",
     "build_line",
     "build_full",
     "build_grid3d",

@@ -47,6 +47,11 @@ TRAJECTORY_FIELDS = (
     # would splice two different worlds. Stored as a stable content digest
     # — the schedule itself can be large (trajectory_meta normalizes it)
     "fault_schedule",
+    # the repair policy rewrites the adjacency at strike rounds, so it is
+    # as trajectory-defining as the schedule itself: resuming a rewire run
+    # under prune (or off) would replay different topologies from the same
+    # checkpoint — refused, like any other trajectory-field mismatch
+    "repair",
 )
 
 
@@ -57,7 +62,10 @@ TRAJECTORY_FIELDS = (
 # edge_chunks, whose CLI knob predates its trajectory-field status: a
 # missing-key checkpoint may have run with ANY chunking, so pinning it
 # would falsely reject the matching resume and silently accept chunking=1.
-LEGACY_FIELD_DEFAULTS = {"fanout": "one", "delivery": "scatter"}
+LEGACY_FIELD_DEFAULTS = {"fanout": "one", "delivery": "scatter",
+                         # pre-repair checkpoints necessarily ran with the
+                         # only behavior that existed: no repair
+                         "repair": "off"}
 
 # Sentinel written for alert_quorum=None (the all-nodes stop rule). None
 # cannot be stored raw: resume validation could not tell "all-nodes run"
@@ -195,7 +203,35 @@ def save(
     tmp = path + ".tmp.npz"
     np.savez_compressed(tmp, __meta__=json.dumps(meta), **arrays)
     os.replace(tmp, path)
+    _sweep_stale_tmps(directory, meta["round"])
     return path
+
+
+def _sweep_stale_tmps(directory: str, published_round: int) -> None:
+    """Remove tmp debris left by crashed saves.
+
+    A crash between ``savez`` and ``os.replace`` leaves a
+    ``ckpt_round*.npz.tmp.npz`` behind; once a checkpoint at the same or
+    a later round publishes, that tmp can never be promoted and would
+    otherwise accumulate forever. Saves are single-writer (process 0
+    only, see ``save``), so a tmp at ``round <= published_round`` is
+    guaranteed dead — tmps for *later* rounds (a crashed save from a
+    run that got further than this one before restarting) are left
+    alone until a publish catches up with them.
+    """
+    prefix, suffix = "ckpt_round", ".npz.tmp.npz"
+    for f in os.listdir(directory):
+        if not (f.startswith(prefix) and f.endswith(suffix)):
+            continue
+        try:
+            r = int(f[len(prefix):-len(suffix)])
+        except ValueError:
+            continue
+        if r <= published_round:
+            try:
+                os.unlink(os.path.join(directory, f))
+            except OSError:
+                pass
 
 
 def load(path: str) -> Tuple[object, dict]:
@@ -209,22 +245,38 @@ def load(path: str) -> Tuple[object, dict]:
     return cls(*fields), meta
 
 
-def latest(directory: str) -> str | None:
-    """Path of the newest checkpoint in ``directory``, or None.
+def candidates(directory: str) -> list:
+    """Published checkpoint paths in ``directory``, newest first.
+
+    The resume fallback chain walks this list: a *published* checkpoint
+    can still be unreadable (bitrot, or a torn write on a filesystem
+    where rename is not atomic), so callers probe each entry with
+    :func:`peek_meta`/:func:`load` and fall back to the next on failure.
 
     ``.tmp.npz`` files are in-flight writes (``save`` publishes via
     ``os.replace``): a crash mid-save can leave a truncated one behind,
     and it must never shadow the last *published* checkpoint — published
-    files are atomic-renamed and therefore always complete.
+    files are atomic-renamed and therefore normally complete.
     """
     if not os.path.isdir(directory):
-        return None
+        return []
     cands = sorted(
-        f for f in os.listdir(directory)
-        if f.startswith("ckpt_round") and f.endswith(".npz")
-        and not f.endswith(".tmp.npz")
+        (f for f in os.listdir(directory)
+         if f.startswith("ckpt_round") and f.endswith(".npz")
+         and not f.endswith(".tmp.npz")),
+        reverse=True,
     )
-    return os.path.join(directory, cands[-1]) if cands else None
+    return [os.path.join(directory, f) for f in cands]
+
+
+def latest(directory: str) -> str | None:
+    """Path of the newest checkpoint in ``directory``, or None.
+
+    (Head of :func:`candidates` — kept as the single-checkpoint entry
+    point for callers that do not want the fallback chain.)
+    """
+    cands = candidates(directory)
+    return cands[0] if cands else None
 
 
 def peek_meta(path: str) -> dict:

@@ -367,3 +367,29 @@ def kill_disconnected(topo, alive: np.ndarray) -> np.ndarray:
         alive[:] = False
         return alive
     return alive & (labels == int(sizes.argmax()))
+
+
+def apply_partition_rule(topo, alive: np.ndarray,
+                         repair_policy: str = "off") -> np.ndarray:
+    """Policy-conditional majority-partition rule.
+
+    ``off`` and ``prune`` run :func:`kill_disconnected` with today's
+    victim set: the engine hands in the birth adjacency (``off``) or the
+    pruned one (``prune`` — dropping dead endpoints never changes the
+    component structure *among live nodes*, the rule masks dead
+    endpoints itself, so the victims match ``off`` bitwise).  Stranded
+    survivors still die.
+
+    ``rewire`` is the policy under which survivors are supposed to stay
+    in the computation: the engine hands in the *repaired* adjacency,
+    where the deterministic splice has already re-attached every orphan,
+    so the rule is normally a no-op.  It still runs as a safety net for
+    the rare fragment the pairing closed on itself (two stubs of one
+    detached island pairing with each other) — such an island would
+    otherwise hang a sound global predicate forever, exactly the hazard
+    documented on :func:`kill_disconnected`.
+    """
+    from gossipprotocol_tpu.topology import repair as repair_mod
+
+    repair_mod.validate_policy(repair_policy)
+    return kill_disconnected(topo, alive)
