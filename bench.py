@@ -64,18 +64,32 @@ def _probe_backend() -> None:
 def main():
     _probe_backend()
 
+    import tempfile
+
     import jax
 
     from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+    from gossipprotocol_tpu.obs import Telemetry, write_manifest
 
     # --- headline: 1M-node imp3D gossip, single chip ---------------------
+    # Spans-only telemetry (counters=False leaves the compiled programs
+    # untouched, so the measurement is the measurement): the per-phase
+    # wall-time split lands in the BENCH record, and the full manifest /
+    # trace in $BENCH_TELEMETRY_DIR for archaeology on regressions.
+    tel_dir = os.environ.get("BENCH_TELEMETRY_DIR") or tempfile.mkdtemp(
+        prefix="bench_telemetry_")
+    tel = Telemetry(tel_dir, counters=False)
     n = int(os.environ.get("BENCH_NODES", 1_000_000))
-    topo = build_topology("imp3D", n, seed=0)
+    with tel.span("topology_build", kind="imp3D", nodes=n):
+        topo = build_topology("imp3D", n, seed=0)
     cfg = RunConfig(algorithm="gossip", seed=0, chunk_rounds=4096,
-                    max_rounds=200_000)
+                    max_rounds=200_000, telemetry=tel)
     res = run_simulation(topo, cfg)
     assert res.converged, f"bench run did not converge: {res.rounds} rounds"
     wall_s = res.wall_ms / 1e3
+    write_manifest(tel, cfg, topo, res, backend=jax.default_backend())
+    tel.close()
+    phase_s = {name: agg["total_s"] for name, agg in tel.phase_rollup().items()}
 
     # --- reference-scale point: 1000 nodes (Report.pdf p.1 ≈ 1150 ms) ----
     topo_1k = build_topology("imp3D", 1000, seed=0)
@@ -99,6 +113,10 @@ def main():
         "backend": jax.default_backend(),
         "aux_1k_ms": round(res_1k.wall_ms, 2),
         "aux_1k_vs_fsharp": round(ref_1k_ms / max(res_1k.wall_ms, 1e-9), 1),
+        # headline run's host-phase split (topology/protocol build, jit
+        # compile, chunks) + where the full manifest/trace landed
+        "phase_s": phase_s,
+        "telemetry_dir": tel_dir,
     }
     # backup record on stderr BEFORE the 10M attempt: a process-fatal 10M
     # failure (OOM-killer, watchdog SIGKILL) must not lose the measured

@@ -23,7 +23,10 @@ inversion), ``--metrics-out``, ``--checkpoint-dir``, ``--resume``,
 ``--fault-plan`` (declarative JSON fault schedule),
 ``--repair`` (self-healing topology repair under churn),
 ``--devices`` (multi-chip sharding),
-``--ws-k/--ws-beta`` (small-world knobs), ``--profile-dir``. Invalid
+``--ws-k/--ws-beta`` (small-world knobs), ``--profile-dir``,
+``--telemetry-dir`` (unified run telemetry; render a telemetry dir with
+the ``report`` subcommand: ``python -m gossipprotocol_tpu report DIR``).
+Invalid
 input errors loudly — the reference silently
 no-ops on unknown topologies (``Program.fs:279``) and prints "option
 invalid" on unknown algorithms (``Program.fs:207``).
@@ -53,12 +56,14 @@ def _unit_fraction(s: str) -> float:
     return v
 
 
-def _build_config(args, algo, fault_schedule, jnp, alert_quorum=None):
+def _build_config(args, algo, fault_schedule, jnp, alert_quorum=None,
+                  telemetry=None):
     """argv -> RunConfig; raises ValueError on invalid combinations
     (caught by main and reported as exit 2, the bad-input contract)."""
     from gossipprotocol_tpu.engine import RunConfig
 
     return RunConfig(
+        telemetry=telemetry,
         algorithm=algo,
         alert_quorum=alert_quorum,
         dtype=jnp.float64 if args.x64 else jnp.float32,
@@ -363,6 +368,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "— push-sum mass is conserved across every rewire")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="emit a jax.profiler trace here")
+    p.add_argument("--telemetry-dir", type=str, default=None, metavar="DIR",
+                   help="unified run telemetry: host spans -> DIR/events.jsonl"
+                        " + a Chrome-trace DIR/trace.json, on-device message "
+                        "counters folded through every chunk, and a run "
+                        "manifest DIR/run.json; render with 'python -m "
+                        "gossipprotocol_tpu report DIR'. Unset = zero cost "
+                        "(the compiled programs are bitwise identical); set, "
+                        "convergence results are STILL bitwise identical — "
+                        "counters ride alongside and never feed back")
     p.add_argument("--compile-cache", type=str,
                    default=os.environ.get(
                        "GOSSIP_TPU_COMPILE_CACHE",
@@ -384,6 +398,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    effective_argv = list(sys.argv[1:] if argv is None else argv)
+    if effective_argv and effective_argv[0] == "report":
+        # subcommand dispatch BEFORE argparse: the run parser has three
+        # required positionals and would reject `report DIR` with its own
+        # usage error
+        from gossipprotocol_tpu.obs.report import main as report_main
+
+        return report_main(effective_argv[1:])
+
     args = build_parser().parse_args(argv)
 
     import jax
@@ -430,7 +453,11 @@ def main(argv=None) -> int:
         print_convergence_time,
         print_start_banner,
     )
+    from gossipprotocol_tpu.obs import Telemetry, write_manifest
+    from gossipprotocol_tpu.obs.telemetry import NULL as _null_telemetry
     from gossipprotocol_tpu.utils.profiling import maybe_trace
+
+    tel = Telemetry(args.telemetry_dir) if args.telemetry_dir else _null_telemetry
 
     algo = _ALGO_ALIASES.get(args.algorithm.lower())
     if algo is None:
@@ -439,7 +466,9 @@ def main(argv=None) -> int:
         return 2
 
     try:
-        topo, alert_quorum = _build_run_topology(args)
+        with tel.span("topology_build", topology=args.topology,
+                      requested_nodes=args.num_nodes):
+            topo, alert_quorum = _build_run_topology(args)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -465,6 +494,7 @@ def main(argv=None) -> int:
               f"directed_edges={topo.num_directed_edges} "
               f"degree min/mean/max = {int(deg.min())}/"
               f"{float(deg.mean()):.2f}/{int(deg.max())}")
+        tel.close()
         return 0
 
     try:
@@ -489,7 +519,8 @@ def main(argv=None) -> int:
 
     try:
         cfg = _build_config(args, algo, schedule, jnp,
-                            alert_quorum=alert_quorum)
+                            alert_quorum=alert_quorum,
+                            telemetry=tel if tel.enabled else None)
         if cfg.delivery == "invert":
             # surface the engine's build-time preconditions as clean CLI
             # input errors (exit 2), not tracebacks mid-run
@@ -561,6 +592,7 @@ def main(argv=None) -> int:
         )
 
     state = None
+    resume_src = resume_round = None
     if args.resume:
         # fallback chain: a *published* checkpoint can still be unreadable
         # (bitrot, or a torn write on a filesystem where rename is not
@@ -576,21 +608,25 @@ def main(argv=None) -> int:
             print(f"no checkpoint found in {args.resume}", file=sys.stderr)
             return 2
         state = meta = None
-        for path in cands:
-            try:
-                ckpt.peek_meta(path)  # cheap probe before the full load
-                state, meta = ckpt.load(path)
-                break
-            except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
-                print(
-                    f"warning: checkpoint {path} unreadable "
-                    f"({type(e).__name__}: {e}); falling back to the "
-                    "previous published checkpoint",
-                    file=sys.stderr,
-                )
+        with tel.span("resume_load", target=args.resume):
+            for path in cands:
+                try:
+                    ckpt.peek_meta(path)  # cheap probe before the full load
+                    state, meta = ckpt.load(path)
+                    break
+                except (OSError, ValueError, KeyError,
+                        zipfile.BadZipFile) as e:
+                    print(
+                        f"warning: checkpoint {path} unreadable "
+                        f"({type(e).__name__}: {e}); falling back to the "
+                        "previous published checkpoint",
+                        file=sys.stderr,
+                    )
         if state is None:
             print(f"no readable checkpoint in {args.resume}", file=sys.stderr)
             return 2
+        resume_src, resume_round = path, int(meta.get("round", -1))
+        tel.event("resume_loaded", checkpoint=path, round=resume_round)
         # a checkpoint from a different experiment would "resume" into a
         # plausible-but-wrong run — validate before continuing (and before
         # anything with side effects, like opening the metrics file).
@@ -645,7 +681,8 @@ def main(argv=None) -> int:
     writer = (
         JsonlMetricsWriter(
             args.metrics_out,
-            mode="a" if (args.resume or args.restarted) else "w")
+            mode="a" if (args.resume or args.restarted) else "w",
+            stamp_version=tel.enabled)
         if args.metrics_out else None
     )
     if writer:
@@ -670,7 +707,11 @@ def main(argv=None) -> int:
         print_start_banner(algo)
 
     try:
-        with maybe_trace(args.profile_dir):
+        # `with tel` makes close (trace flush + end marker) exception-safe:
+        # it runs on success, on every error path below, and before the
+        # recovery re-exec — the manifest is written afterwards (it only
+        # reads accumulated totals, never the event stream)
+        with tel, maybe_trace(args.profile_dir):
             if args.devices > 1:
                 from gossipprotocol_tpu.parallel import run_simulation_sharded
 
@@ -691,6 +732,8 @@ def main(argv=None) -> int:
         if isinstance(e, RoutedConfigError):
             if writer:
                 writer.close()
+            write_manifest(tel, cfg, topo, None, backend=backend_name,
+                           num_devices=args.devices, error=str(e))
             print(str(e), file=sys.stderr)
             return 2
         if not (_is_runtime_death(e) and args.auto_resume > 0):
@@ -755,10 +798,21 @@ def main(argv=None) -> int:
             + f", {args.auto_resume - 1} recovery attempts left",
             file=sys.stderr,
         )
+        write_manifest(
+            tel, cfg, topo, None, backend=backend_name,
+            num_devices=args.devices, resumed_from=resume_src,
+            resume_round=resume_round,
+            error=f"accelerator runtime died: {type(e).__name__}",
+        )
         return _reexec(new_argv)
 
     if writer:
         writer.close()
+    manifest_path = write_manifest(
+        tel, cfg, topo, result, backend=backend_name,
+        num_devices=args.devices, resumed_from=resume_src,
+        resume_round=resume_round,
+    )
 
     print_convergence_time(result.wall_ms)
     if not args.quiet:
@@ -768,6 +822,9 @@ def main(argv=None) -> int:
         err = result.estimate_error
         if err is not None:
             print(f"push-sum max |s/w - mean| = {err:.3e}")
+        if manifest_path:
+            print(f"telemetry: {tel.dir} (render: python -m "
+                  f"gossipprotocol_tpu report {tel.dir})")
     return 0 if result.converged else 1
 
 

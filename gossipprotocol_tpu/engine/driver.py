@@ -135,6 +135,18 @@ class RunConfig:
                                    # topology/repair.py). Trajectory
                                    # field: the policy rewrites the
                                    # adjacency mid-run
+    telemetry: Optional[Any] = None  # obs.Telemetry hub (None = off). Off
+                                   # means *zero cost*: the compiled
+                                   # programs are the ones this config
+                                   # always built. On, the engines emit
+                                   # spans/manifests and fold message
+                                   # counters through the chunk scan —
+                                   # counters ride a side buffer and
+                                   # never feed back, so the state
+                                   # trajectory stays bitwise identical
+                                   # (tests/test_telemetry.py). NOT a
+                                   # trajectory field for exactly that
+                                   # reason
 
     @property
     def schedule(self):
@@ -474,18 +486,8 @@ def build_protocol(
             if cfg.delivery != "routed" and cfg.edge_chunks > 1:
                 core = partial(core, edge_chunks=cfg.edge_chunks)
             if cfg.delivery == "routed":
-                # Mosaic kernels only exist for TPU; every other backend
-                # (the CPU test mesh included) runs the same kernels
-                # through the Pallas interpreter. jax_default_device may
-                # hold a Device or a bare platform string.
-                dev = jax.config.jax_default_device
-                if dev is None:
-                    plat = jax.default_backend()
-                elif isinstance(dev, str):
-                    plat = dev
-                else:
-                    plat = dev.platform
-                core = partial(core, interpret=(plat != "tpu"))
+                core = partial(
+                    core, interpret=(default_platform() != "tpu"))
         elif ref:
             # the reference's actual dynamics: a single-token random walk
             # (one MainPushSum in flight, Program.fs:128; SURVEY §2.4.2).
@@ -590,6 +592,17 @@ def build_protocol(
     return state, core, done_fn, extra_stats, (all_alive, targets_alive)
 
 
+def default_platform() -> str:
+    """The platform the default device lives on ("tpu", "cpu", ...) —
+    selects compiled Mosaic kernels vs the Pallas interpreter for the
+    routed delivery. ``jax_default_device`` may hold a Device or a bare
+    platform string."""
+    dev = jax.config.jax_default_device
+    if dev is None:
+        return jax.default_backend()
+    return dev if isinstance(dev, str) else dev.platform
+
+
 def require_invertible(topo: Topology) -> None:
     """delivery='invert' precondition: the dense table must be in use.
 
@@ -662,16 +675,30 @@ def gossip_inversion_enabled(topo: Topology, cfg: RunConfig) -> bool:
     )
 
 
-def device_arrays(topo: Topology, cfg: RunConfig):
+def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
     """The runtime adjacency pytree the chunk runner threads through:
     sampled neighbor tables for the single-target senders (plus the
     reverse-slot inversion tables for dense gossip), the edge list for
-    fanout-all diffusion (which draws nothing and walks every edge)."""
+    fanout-all diffusion (which draws nothing and walks every edge).
+
+    ``tel`` (an :mod:`~gossipprotocol_tpu.obs` telemetry hub or None)
+    receives the routed plan's cache provenance — whether the tables were
+    loaded (``hit``), compiled (``miss``), or built uncached (``off``).
+    """
     if cfg.algorithm == "push-sum" and cfg.fanout == "all":
         if cfg.delivery == "routed":
+            from gossipprotocol_tpu.ops.delivery import (
+                routed_streamed_bytes_per_round,
+            )
             from gossipprotocol_tpu.ops.plancache import routed_delivery_cached
 
-            rd, _ = routed_delivery_cached(topo, cache_dir=cfg.plan_cache)
+            rd, prov = routed_delivery_cached(topo, cache_dir=cfg.plan_cache)
+            if tel is not None and tel.enabled:
+                tel.event(
+                    "plan_cache", provenance=prov, design="single-chip",
+                    streamed_bytes_per_round=routed_streamed_bytes_per_round(
+                        rd),
+                )
             return rd
         from gossipprotocol_tpu.protocols.diffusion import diffusion_edges
 
@@ -737,22 +764,76 @@ def stats_with_extra(state, done_fn, extra_stats) -> dict:
     return rec
 
 
-def make_chunk_runner(round_core, done_fn, extra_stats=None):
+def mass_stats(state, all_sum=jnp.sum) -> dict:
+    """On-device conservation scalars for the telemetry counters: total
+    push-sum mass ``(Σs, Σw)`` over every row, in the state dtype. The
+    walk's in-flight token carries real mass, so it is included. Empty
+    for mass-free states (gossip). ``all_sum`` is the cross-shard
+    reduction under ``shard_map``.
+
+    The drift baseline is taken from the *same compiled reduction* (a
+    no-op ``step(state, -1)`` at drive start), so a lossless run reports
+    exactly 0 ULPs — comparing against an eager host sum would
+    manufacture drift out of reduction-order rounding."""
+    if not hasattr(state, "s"):
+        return {}
+    ms = all_sum(state.s)
+    mw = all_sum(state.w)
+    if hasattr(state, "msg_s"):
+        ms = ms + state.msg_s
+        mw = mw + state.msg_w
+    return {"mass_s": ms, "mass_w": mw}
+
+
+def make_chunk_runner(round_core, done_fn, extra_stats=None,
+                      counter_fn=None, counter_slots=0):
     """jitted ``(state, nbrs, base_key, round_limit) -> (state, stats)``:
     advance rounds until global convergence or ``state.round ==
     round_limit``. The supervisor predicate is evaluated in the loop
     condition — the reference's flow 3.4 folded into cond_fun — and again
-    in the returned stats so the host loop needs one fetch per chunk."""
+    in the returned stats so the host loop needs one fetch per chunk.
+
+    ``counter_fn`` (obs/counters.py contract) folds an int32
+    ``[counter_slots, 3]`` message-count buffer through the scan — one
+    delta row per round, read back with the chunk stats. With it unset
+    the traced program is *identical* to before telemetry existed (the
+    zero-cost-off contract); with it set the state trajectory is still
+    bitwise unchanged because the buffer never feeds back into the round.
+    """
+    if counter_fn is None:
+        def chunk(state, nbrs, base_key, round_limit):
+            def body(s):
+                return round_core(s, nbrs, base_key)
+
+            def cond(s):
+                return jnp.logical_and(~done_fn(s), s.round < round_limit)
+
+            final = jax.lax.while_loop(cond, body, state)
+            return final, stats_with_extra(final, done_fn, extra_stats)
+
+        return jax.jit(chunk, donate_argnums=0)
 
     def chunk(state, nbrs, base_key, round_limit):
-        def body(s):
-            return round_core(s, nbrs, base_key)
+        start = state.round  # chunk entry round: buffer row 0
 
-        def cond(s):
+        def body(carry):
+            s, buf = carry
+            s2 = round_core(s, nbrs, base_key)
+            delta = counter_fn(s, s2, nbrs, base_key, s.alive, None)
+            buf = jax.lax.dynamic_update_slice(
+                buf, delta[None, :], (s.round - start, jnp.int32(0)))
+            return s2, buf
+
+        def cond(carry):
+            s, _ = carry
             return jnp.logical_and(~done_fn(s), s.round < round_limit)
 
-        final = jax.lax.while_loop(cond, body, state)
-        return final, stats_with_extra(final, done_fn, extra_stats)
+        buf0 = jnp.zeros((counter_slots, 3), jnp.int32)
+        final, buf = jax.lax.while_loop(cond, body, (state, buf0))
+        stats = stats_with_extra(final, done_fn, extra_stats)
+        stats["counters"] = buf
+        stats.update(mass_stats(final))
+        return final, stats
 
     return jax.jit(chunk, donate_argnums=0)
 
@@ -843,9 +924,12 @@ def _drive(
     the adjacency actually in force at entry — the birth topology unless
     a resume already replayed repair events past it.
     """
+    from gossipprotocol_tpu.obs import as_telemetry
+    from gossipprotocol_tpu.obs.counters import ulp_drift
     from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
     from gossipprotocol_tpu.utils import faults as faults_mod
 
+    tel = as_telemetry(cfg.telemetry)
     run_topo = run_topo if run_topo is not None else topo
     sched = cfg.schedule
     kills = {r: np.asarray(v, dtype=np.int64)
@@ -874,6 +958,19 @@ def _drive(
     # once per run, not per checkpoint (crc over the CSR)
     adjacency = ckpt_mod.topology_fingerprint(topo) if checkpointing else None
 
+    mass_base = None
+    if tel.counters_on:
+        # anchor the conservation baseline with the *same compiled
+        # reduction* the chunk stats use: a no-op chunk (round_limit=-1,
+        # the warm-start trick — the body never runs) returns the mass
+        # sums without advancing the state. An eager host sum here would
+        # manufacture ULP drift out of reduction-order rounding.
+        with tel.span("mass_baseline"):
+            state, _bs = step(state, -1)
+            _bh = jax.device_get(_bs)
+        if "mass_s" in _bh:
+            mass_base = (_bh["mass_s"], _bh["mass_w"])
+
     t0 = time.perf_counter()
     while True:
         if cur_round >= cfg.max_rounds:
@@ -886,114 +983,146 @@ def _drive(
         due_k = sorted(r for r in kills if r <= cur_round)
         due_r = sorted(r for r in revives if r <= cur_round)
         if due_k or due_r:
-            alive_host = np.array(ckpt_mod.fetch_host(state.alive))  # writable copy
-            before = alive_host.copy()
-            req_revive = (np.concatenate([revives[r] for r in due_r])
-                          if due_r else np.empty(0, np.int64))
-            for r in due_k:
-                alive_host[kills.pop(r)] = False
-            for r in due_r:
-                alive_host[revives.pop(r)] = True
-            repair_stats = None
-            if cfg.repair == "off":
-                # unreachable-from-the-majority == failed: stranded
-                # survivors and fault-split minority components would hang
-                # the predicate forever (majority-partition semantics).
-                # Re-run after revives too: a returning node counts only
-                # once it is reattached to the majority component —
-                # otherwise it stays dead (and keeps its scheduled id; a
-                # later revive can still reattach it).
-                alive_host[: topo.num_nodes] = faults_mod.kill_disconnected(
-                    topo, alive_host[: topo.num_nodes]
-                )
-            else:
-                # self-healing (topology/repair.py): prune dead endpoints
-                # from the CSR (rewire additionally re-splices survivors),
-                # then the policy-conditional partition rule runs against
-                # the *repaired* adjacency — under rewire the splice has
-                # already reattached orphans, so stranded survivors stay
-                # in the computation instead of being executed
-                from gossipprotocol_tpu.topology import repair as repair_mod
+            with tel.span("fault_event", round=cur_round,
+                          kills=len(due_k), revives=len(due_r)):
+                alive_host = np.array(ckpt_mod.fetch_host(state.alive))  # writable copy
+                before = alive_host.copy()
+                req_revive = (np.concatenate([revives[r] for r in due_r])
+                              if due_r else np.empty(0, np.int64))
+                for r in due_k:
+                    alive_host[kills.pop(r)] = False
+                for r in due_r:
+                    alive_host[revives.pop(r)] = True
+                repair_stats = None
+                if cfg.repair == "off":
+                    # unreachable-from-the-majority == failed: stranded
+                    # survivors and fault-split minority components would hang
+                    # the predicate forever (majority-partition semantics).
+                    # Re-run after revives too: a returning node counts only
+                    # once it is reattached to the majority component —
+                    # otherwise it stays dead (and keeps its scheduled id; a
+                    # later revive can still reattach it).
+                    alive_host[: topo.num_nodes] = faults_mod.kill_disconnected(
+                        topo, alive_host[: topo.num_nodes]
+                    )
+                else:
+                    # self-healing (topology/repair.py): prune dead endpoints
+                    # from the CSR (rewire additionally re-splices survivors),
+                    # then the policy-conditional partition rule runs against
+                    # the *repaired* adjacency — under rewire the splice has
+                    # already reattached orphans, so stranded survivors stay
+                    # in the computation instead of being executed
+                    from gossipprotocol_tpu.topology import repair as repair_mod
 
-                run_topo, repair_stats = repair_mod.repair_topology(
-                    run_topo, alive_host[: topo.num_nodes], cfg.repair,
-                    run_seed=cfg.seed, event_round=cur_round,
-                    revived=req_revive,
-                )
-                alive_host[: topo.num_nodes] = faults_mod.apply_partition_rule(
-                    run_topo, alive_host[: topo.num_nodes], cfg.repair
-                )
-            alive_host[topo.num_nodes:] = False  # padding rows never live
-            # nodes that actually (re)joined — revive ids that survived
-            # the majority rule — restart from fresh-born state
-            reborn = np.flatnonzero(alive_host & ~before)
-            if reborn.size:
-                state = revive_rows(state, reborn, cfg, topo.num_nodes)
-            # apply the alive diff on device (scatter), keeping the buffer
-            # XLA-owned — a zero-copy device_put of the numpy array would
-            # feed externally-owned memory into the donating step
-            import jax.numpy as jnp
+                    run_topo, repair_stats = repair_mod.repair_topology(
+                        run_topo, alive_host[: topo.num_nodes], cfg.repair,
+                        run_seed=cfg.seed, event_round=cur_round,
+                        revived=req_revive,
+                    )
+                    alive_host[: topo.num_nodes] = faults_mod.apply_partition_rule(
+                        run_topo, alive_host[: topo.num_nodes], cfg.repair
+                    )
+                alive_host[topo.num_nodes:] = False  # padding rows never live
+                # nodes that actually (re)joined — revive ids that survived
+                # the majority rule — restart from fresh-born state
+                reborn = np.flatnonzero(alive_host & ~before)
+                if reborn.size:
+                    state = revive_rows(state, reborn, cfg, topo.num_nodes)
+                # apply the alive diff on device (scatter), keeping the buffer
+                # XLA-owned — a zero-copy device_put of the numpy array would
+                # feed externally-owned memory into the donating step
+                import jax.numpy as jnp
 
-            newly_dead = np.flatnonzero(before & ~alive_host)
-            alive_dev = state.alive
-            if newly_dead.size:
-                alive_dev = alive_dev.at[
-                    jnp.asarray(newly_dead, jnp.int32)].set(False)
-            if reborn.size:
-                alive_dev = alive_dev.at[
-                    jnp.asarray(reborn, jnp.int32)].set(True)
-            if alive_dev.sharding != state.alive.sharding:
-                # the compiled step expects its input layout unchanged
-                alive_dev = jax.device_put(alive_dev, state.alive.sharding)
-            state = state._replace(alive=alive_dev)
+                newly_dead = np.flatnonzero(before & ~alive_host)
+                alive_dev = state.alive
+                if newly_dead.size:
+                    alive_dev = alive_dev.at[
+                        jnp.asarray(newly_dead, jnp.int32)].set(False)
+                if reborn.size:
+                    alive_dev = alive_dev.at[
+                        jnp.asarray(reborn, jnp.int32)].set(True)
+                if alive_dev.sharding != state.alive.sharding:
+                    # the compiled step expects its input layout unchanged
+                    alive_dev = jax.device_put(alive_dev, state.alive.sharding)
+                state = state._replace(alive=alive_dev)
 
-            if repair_stats is not None:
-                info: dict = {}
-                rebuild_s = 0.0
-                if repair_stats["changed"]:
-                    if rebuild is None:
-                        raise RuntimeError(
-                            "repair event fired but the engine supplied "
-                            "no rebuild hook"
-                        )
-                    # repair must never touch protocol state: push-sum
-                    # mass over every row is conserved *exactly* across
-                    # the device rebuild (float64 host sums of the same
-                    # bits — any drift means the rebuild corrupted or
-                    # re-initialized a buffer)
-                    mass0 = _mass_snapshot(state)
-                    t0r = time.perf_counter()
-                    step, state, info = rebuild(run_topo, state)
-                    rebuild_s = time.perf_counter() - t0r
-                    mass1 = _mass_snapshot(state)
-                    if mass0 != mass1:
-                        raise AssertionError(
-                            f"repair rebuild changed protocol mass: "
-                            f"{mass0} -> {mass1} (policy={cfg.repair}, "
-                            f"round={cur_round})"
-                        )
-                rec = {
-                    "event": "repair",
-                    "round": cur_round,
-                    "policy": cfg.repair,
-                    "rebuild_s": rebuild_s,
-                    **{k: v for k, v in repair_stats.items()},
-                    **info,
-                }
-                metrics.append(rec)
-                if cfg.metrics_callback:
-                    cfg.metrics_callback(rec)
+                if repair_stats is not None:
+                    info: dict = {}
+                    rebuild_s = 0.0
+                    if repair_stats["changed"]:
+                        if rebuild is None:
+                            raise RuntimeError(
+                                "repair event fired but the engine supplied "
+                                "no rebuild hook"
+                            )
+                        # repair must never touch protocol state: push-sum
+                        # mass over every row is conserved *exactly* across
+                        # the device rebuild (float64 host sums of the same
+                        # bits — any drift means the rebuild corrupted or
+                        # re-initialized a buffer)
+                        mass0 = _mass_snapshot(state)
+                        t0r = time.perf_counter()
+                        step, state, info = rebuild(run_topo, state)
+                        rebuild_s = time.perf_counter() - t0r
+                        mass1 = _mass_snapshot(state)
+                        if mass0 != mass1:
+                            raise AssertionError(
+                                f"repair rebuild changed protocol mass: "
+                                f"{mass0} -> {mass1} (policy={cfg.repair}, "
+                                f"round={cur_round})"
+                            )
+                    rec = {
+                        "event": "repair",
+                        "round": cur_round,
+                        "policy": cfg.repair,
+                        "rebuild_s": rebuild_s,
+                        **{k: v for k, v in repair_stats.items()},
+                        **info,
+                    }
+                    metrics.append(rec)
+                    tel.metric(rec)
+                    if cfg.metrics_callback:
+                        cfg.metrics_callback(rec)
+
+                if reborn.size and mass_base is not None:
+                    # revive_rows overwrote rows with fresh-born (s, w):
+                    # the conserved quantity itself legitimately changed
+                    # (stranded pre-death mass discarded) — re-anchor the
+                    # drift baseline with the same no-op-chunk reduction
+                    state, _bs = step(state, -1)
+                    _bh = jax.device_get(_bs)
+                    mass_base = (_bh["mass_s"], _bh["mass_w"])
 
         next_event = min([*kills, *revives], default=cfg.max_rounds)
         round_limit = min(cur_round + chunk_rounds, cfg.max_rounds, next_event)
 
-        state, stats = step(state, round_limit)
-        chunk_i += 1
-
-        host = jax.device_get(stats)  # the one blocking transfer per chunk
+        with tel.span("chunk", round_start=cur_round,
+                      round_limit=round_limit):
+            state, stats = step(state, round_limit)
+            chunk_i += 1
+            # the device_get is the sync point, so the span covers the
+            # on-device work, not just the dispatch
+            host = jax.device_get(stats)  # the one blocking transfer per chunk
         cur_round = int(host.pop("round"))
         done = bool(host.pop("done"))
+        counters = host.pop("counters", None)
+        chunk_mass = (host.pop("mass_s", None), host.pop("mass_w", None))
         rec = {"round": cur_round, **{k: v.item() for k, v in host.items()}}
+        if counters is not None:
+            # per-round int32 delta rows; cumulative totals as Python
+            # ints so multi-billion-message runs never overflow
+            sent, delivered, dropped = (
+                int(x) for x in np.asarray(counters, np.int64).sum(axis=0))
+            rec["sent"] = sent
+            rec["delivered"] = delivered
+            rec["dropped"] = dropped
+            tel.add_counters(sent, delivered, dropped)
+        if chunk_mass[0] is not None and mass_base is not None:
+            s_ulps = ulp_drift(chunk_mass[0], mass_base[0])
+            w_ulps = ulp_drift(chunk_mass[1], mass_base[1])
+            rec["mass_drift_ulps"] = s_ulps
+            rec["w_drift_ulps"] = w_ulps
+            tel.note_mass_drift(s_ulps, w_ulps)
         if rec.get("w_underflow", 0) and not underflow_warned:
             # measured failure mode (README "Convergence-predicate
             # soundness", 100M artifact): warn once with the cures
@@ -1017,18 +1146,21 @@ def _drive(
             # pointless
             rec["stalled"] = True
         metrics.append(rec)
+        tel.metric(rec)
         if cfg.metrics_callback:
             cfg.metrics_callback(rec)
         if checkpointing and chunk_i % cfg.checkpoint_every == 0:
-            checkpoints.append(
-                ckpt_mod.save(
-                    cfg.checkpoint_dir, trim(state), cfg, topo.kind,
-                    adjacency=adjacency,
+            with tel.span("checkpoint_save", round=cur_round):
+                checkpoints.append(
+                    ckpt_mod.save(
+                        cfg.checkpoint_dir, trim(state), cfg, topo.kind,
+                        adjacency=adjacency,
+                    )
                 )
-            )
         if done or stalled:
             break
-    jax.block_until_ready(state)
+    with tel.span("device_sync"):
+        jax.block_until_ready(state)
     wall_ms = (time.perf_counter() - t0) * 1e3
 
     return RunResult(
@@ -1069,24 +1201,56 @@ def run_simulation(
         run_topo = repair_mod.replay_repaired_topology(
             topo, cfg.schedule, cfg.repair, cfg.seed, start_round
         )
-    state, round_core, done_fn, extra_stats, _ = build_protocol(
-        run_topo, cfg, allow_all_alive=resume_allows_fast(topo, initial_state)
-    )
-    if initial_state is not None:
-        # copy: the chunk runner donates its input buffers, and consuming
-        # the caller's arrays in-place would be a surprising API
-        state = jax.tree.map(jnp.array, initial_state)
-    nbrs = device_arrays(run_topo, cfg)
+    from gossipprotocol_tpu.obs import as_telemetry
+
+    tel = as_telemetry(cfg.telemetry)
+    with tel.span("protocol_build", engine="single-chip"):
+        state, round_core, done_fn, extra_stats, (all_alive, targets_alive) = (
+            build_protocol(
+                run_topo, cfg,
+                allow_all_alive=resume_allows_fast(topo, initial_state),
+            )
+        )
+        if initial_state is not None:
+            # copy: the chunk runner donates its input buffers, and
+            # consuming the caller's arrays in-place would be a surprising
+            # API
+            state = jax.tree.map(jnp.array, initial_state)
+    with tel.span("plan_compile", engine="single-chip"):
+        nbrs = device_arrays(run_topo, cfg, tel=tel)
     base_key = jax.random.key(cfg.seed)
-    runner = make_chunk_runner(round_core, done_fn, extra_stats)
+    # counter slots must match _drive's chunk sizing exactly (one delta
+    # row per round of the largest possible chunk)
+    counter_slots = cfg.resolve_chunk_rounds(
+        topo.num_nodes,
+        None if topo.implicit_full else int(topo.indices.size),
+    )
+
+    def engine_counter_fn(ctopo, aa, ta):
+        if not tel.counters_on:
+            return None
+        from gossipprotocol_tpu.obs.counters import make_counter_fn
+
+        return make_counter_fn(
+            ctopo, cfg, all_alive=aa, targets_alive=ta,
+            interpret=(default_platform() != "tpu"),
+        )
+
+    runner = make_chunk_runner(
+        round_core, done_fn, extra_stats,
+        counter_fn=engine_counter_fn(run_topo, all_alive, targets_alive),
+        counter_slots=counter_slots,
+    )
 
     t0 = time.perf_counter()
-    compiled = runner.lower(state, nbrs, base_key, jnp.int32(0)).compile()
+    with tel.span("jit_compile", engine="single-chip"):
+        compiled = runner.lower(state, nbrs, base_key, jnp.int32(0)).compile()
 
     def step(s, round_limit):
         return compiled(s, nbrs, base_key, jnp.int32(round_limit))
 
-    state = warm_start(step, state)
+    with tel.span("warm_start"):
+        state = warm_start(step, state)
     compile_ms = (time.perf_counter() - t0) * 1e3
 
     def rebuild(new_topo, st):
@@ -1096,12 +1260,16 @@ def run_simulation(
         # state pytree is shape-stable (num_nodes never changes), so the
         # live buffers thread straight through.
         t0p = time.perf_counter()
-        _, core2, done2, extra2, _ = build_protocol(
+        _, core2, done2, extra2, (aa2, ta2) = build_protocol(
             new_topo, cfg, allow_all_alive=False
         )
-        nbrs2 = device_arrays(new_topo, cfg)
+        nbrs2 = device_arrays(new_topo, cfg, tel=tel)
         plan_patch_s = time.perf_counter() - t0p
-        runner2 = make_chunk_runner(core2, done2, extra2)
+        runner2 = make_chunk_runner(
+            core2, done2, extra2,
+            counter_fn=engine_counter_fn(new_topo, aa2, ta2),
+            counter_slots=counter_slots,
+        )
         compiled2 = runner2.lower(st, nbrs2, base_key, jnp.int32(0)).compile()
 
         def step2(s, round_limit):

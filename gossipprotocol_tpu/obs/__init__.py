@@ -1,0 +1,34 @@
+"""Unified run telemetry (SURVEY.md §5.5 grown up).
+
+The reference's observability surface is one ``Stopwatch`` and three
+``printfn`` lines (``Program.fs:35,55,198,204``); the system around our
+reproduction — sharded routed delivery, fault schedules, the parallel
+plan compiler, self-healing repair — is far too complex to debug from a
+single "Convergence Time" line. This package makes every run optionally
+self-describing:
+
+* :mod:`~gossipprotocol_tpu.obs.telemetry` — host-side spans streamed to
+  ``events.jsonl`` plus a Chrome-trace ``trace.json`` (Perfetto-loadable),
+  complementing ``--profile-dir``'s device-level ``jax.profiler`` trace;
+* :mod:`~gossipprotocol_tpu.obs.counters` — on-device message counters
+  folded through the chunk scan (sent / delivered / dropped, push-sum
+  mass drift in ULPs), riding *alongside* protocol state so convergence
+  stays bitwise identical with telemetry on;
+* :mod:`~gossipprotocol_tpu.obs.manifest` — ``run.json``: the full
+  config, versions, digests, resume lineage, and per-phase wall-time
+  rollup that makes any BENCH/MULTICHIP number reproducible;
+* :mod:`~gossipprotocol_tpu.obs.report` — ``python -m gossipprotocol_tpu
+  report DIR`` renders a telemetry dir for humans.
+
+Zero-cost contract: with ``RunConfig.telemetry`` unset every engine code
+path sees :class:`NullTelemetry` (no-op spans, ``counters_on=False``), so
+the compiled chunk programs — and therefore results and metrics records —
+are bitwise identical to a build without this package.
+"""
+
+from gossipprotocol_tpu.obs.manifest import write_manifest  # noqa: F401
+from gossipprotocol_tpu.obs.telemetry import (  # noqa: F401
+    NullTelemetry,
+    Telemetry,
+    as_telemetry,
+)

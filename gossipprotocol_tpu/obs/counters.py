@@ -1,0 +1,177 @@
+"""On-device message counters folded through the chunk scan.
+
+:func:`make_counter_fn` mirrors :func:`engine.driver.build_protocol`'s
+dispatch exactly — one counter function per protocol/delivery branch,
+each implemented next to the round it measures (``protocols/gossip.py``,
+``protocols/pushsum.py``, ``protocols/diffusion.py``,
+``ops/sharddelivery.py``) so the two can never drift apart silently.
+
+The returned function has one fixed call shape for both engines::
+
+    counter_fn(old_state, new_state, nbrs, base_key, alive_global, gids)
+        -> int32[3]   # (sent, delivered, dropped) over the LOCAL rows
+
+and is called once per round *inside* the jitted ``while_loop`` body.
+Under ``shard_map`` the caller ``psum``\\ s the vector (every component is
+a sum of per-row contributions, so local-then-psum is exact).
+
+Correctness contract (the bitwise-invariance tests pin this):
+
+* counter functions only **read** the old/new states — they re-derive the
+  round's draws through the very same counter-based primitives
+  (:func:`protocols.sampling.sample_neighbors` / ``drop_mask``) the round
+  itself used, so no state bit and no PRNG stream is ever perturbed;
+* the counters ride in a side buffer of the loop carry and never feed
+  back, so the state trajectory with telemetry on is bitwise identical
+  to telemetry off.
+
+Counter semantics, uniform across protocols:
+
+* ``sent`` — messages a live node attempted this round (including ones a
+  converged/dead receiver will ignore);
+* ``delivered`` — messages accepted by a receiver (gossip: hits actually
+  credited, i.e. receiver-side suppression excluded; push-sum: shares
+  that moved mass);
+* ``dropped`` — messages lost to an active loss window (mass-conserving
+  drops: the sender kept the share).
+
+Counts are int32 (a single round's message count is bounded by the
+directed edge count, itself int32-indexed); the per-round delta rows are
+summed on the host as Python ints, so *cumulative* totals never overflow.
+The one exception is the implicit complete graph, where a round sends
+``a·(a−1)`` messages — computed in f32 and clipped to ``INT32_MAX`` (the
+count saturates beyond ~46 k alive nodes; the metrics record notes carry
+exact values only below that).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+COUNTER_FIELDS = ("sent", "delivered", "dropped")
+NUM_COUNTERS = len(COUNTER_FIELDS)
+
+
+def make_counter_fn(
+    topo,
+    cfg,
+    *,
+    all_alive: bool,
+    targets_alive: bool,
+    all_sum: Optional[Callable] = None,
+    interpret: bool = False,
+    axis_name: Optional[str] = None,
+) -> Callable:
+    """Build the per-round counter function for this run's exact branch.
+
+    ``all_alive`` / ``targets_alive`` must be the flag pair
+    ``build_protocol`` returned (they select the same fast paths the
+    round compiled with). ``all_sum`` is the cross-shard scalar reduction
+    (``jnp.sum`` single-chip, a psum closure under ``shard_map``) — only
+    the implicit-complete-graph branch needs it. ``interpret`` /
+    ``axis_name`` parameterize the routed-delivery branches the same way
+    the round cores take them.
+    """
+    n = topo.num_nodes
+    loss_windows = cfg.schedule.static_loss_windows()
+    if all_sum is None:
+        all_sum = jnp.sum
+
+    if cfg.algorithm == "gossip":
+        from gossipprotocol_tpu.engine.driver import effective_keep_alive
+        from gossipprotocol_tpu.protocols.gossip import gossip_message_counts
+
+        keep_alive = effective_keep_alive(topo, cfg)
+
+        def fn(old, new, nbrs, base_key, alive_global, gids):
+            return gossip_message_counts(
+                old, new, nbrs, base_key, n=n, gids=gids,
+                keep_alive=keep_alive, all_alive=all_alive,
+                loss_windows=loss_windows,
+            )
+
+        return fn
+
+    if cfg.semantics == "reference" and cfg.fanout == "one":
+        # the single-token walk: exactly one message per hop, no loss
+        # (RunConfig rejects fault schedules for the walk)
+        def fn(old, new, nbrs, base_key, alive_global, gids):
+            return jnp.array([1, 1, 0], jnp.int32)
+
+        return fn
+
+    if cfg.fanout == "all":
+        if cfg.delivery == "routed":
+            if axis_name is not None:
+                from gossipprotocol_tpu.ops.sharddelivery import (
+                    shard_routed_message_counts,
+                )
+
+                fast = all_alive or targets_alive
+
+                def fn(old, new, nbrs, base_key, alive_global, gids):
+                    return shard_routed_message_counts(
+                        old, nbrs, design=cfg.routed_design,
+                        axis_name=axis_name, interpret=interpret,
+                        fast_alive=fast, all_alive=all_alive,
+                    )
+
+                return fn
+
+            from gossipprotocol_tpu.protocols.diffusion import (
+                routed_message_counts,
+            )
+
+            def fn(old, new, nbrs, base_key, alive_global, gids):
+                return routed_message_counts(
+                    old, nbrs, n=n, all_alive=all_alive,
+                    targets_alive=targets_alive, interpret=interpret,
+                )
+
+            return fn
+
+        from gossipprotocol_tpu.protocols.diffusion import (
+            diffusion_message_counts,
+        )
+
+        def fn(old, new, nbrs, base_key, alive_global, gids):
+            return diffusion_message_counts(
+                old, nbrs, base_key, n=n, gids=gids, all_alive=all_alive,
+                targets_alive=targets_alive, loss_windows=loss_windows,
+                alive_global=alive_global, all_sum=all_sum,
+            )
+
+        return fn
+
+    from gossipprotocol_tpu.protocols.pushsum import pushsum_message_counts
+
+    def fn(old, new, nbrs, base_key, alive_global, gids):
+        return pushsum_message_counts(
+            old, nbrs, base_key, n=n, gids=gids, all_alive=all_alive,
+            targets_alive=targets_alive, delivery=cfg.delivery,
+            loss_windows=loss_windows, alive_global=alive_global,
+        )
+
+    return fn
+
+
+def ulp_drift(value, baseline) -> float:
+    """|value − baseline| measured in ULPs *of the baseline's dtype*.
+
+    Both values come straight off the device (numpy scalars in the run
+    dtype), so ``np.spacing`` yields the correct unit in f32 and f64
+    runs alike. Exact-conservation runs (dyadic push-sum arithmetic)
+    report exactly 0.0; any rounding or genuine mass change is >= 1.
+    """
+    b = np.asarray(baseline)
+    v = float(np.float64(value))
+    bf = float(np.float64(b))
+    if v == bf:
+        return 0.0
+    ulp = float(np.spacing(np.abs(b).astype(b.dtype, copy=False)))
+    if ulp == 0.0:  # baseline exactly 0 in a zero-width format corner
+        ulp = float(np.spacing(np.asarray(0, b.dtype)))
+    return abs(v - bf) / ulp

@@ -1,0 +1,133 @@
+"""Run manifest: one ``run.json`` that makes a telemetry dir self-describing.
+
+Everything a reader needs to interpret ``events.jsonl`` / ``trace.json`` /
+the metrics stream without the launching shell: the full RunConfig, the
+software stack (package + jax versions, backend, device count), content
+digests identifying the topology and fault schedule (the same digests the
+checkpoint trajectory metadata uses, so manifests and checkpoints
+cross-reference), resume lineage, the final metric, counter totals, and
+the per-phase wall-time rollup.
+
+Written once, atomically, when the run finishes (or dies — the CLI writes
+it in a ``finally``); ``events.jsonl`` stays the crash-durable record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
+
+# runtime-only fields that either cannot serialize (callbacks, the
+# telemetry hub itself) or are captured in richer form elsewhere
+_SKIP_CONFIG_FIELDS = ("metrics_callback", "telemetry", "fault_schedule",
+                       "fault_plan")
+
+
+def config_doc(cfg) -> Dict[str, Any]:
+    """RunConfig -> json-able dict; the fault schedule is folded to its
+    normalized digest + event counts rather than dumped raw (large id
+    lists belong in the fault-plan file, not every manifest)."""
+    doc: Dict[str, Any] = {}
+    for f in dataclasses.fields(cfg):
+        if f.name in _SKIP_CONFIG_FIELDS:
+            continue
+        v = getattr(cfg, f.name)
+        if f.name == "dtype":
+            import jax.numpy as jnp
+
+            v = str(jnp.dtype(v))
+        doc[f.name] = v
+    sched = cfg.schedule
+    doc["fault_schedule"] = {
+        "digest": sched.digest(),
+        "kill_events": len(sched.kills),
+        "revive_events": len(sched.revives),
+        "loss_windows": len(sched.loss),
+    }
+    return doc
+
+
+def build_manifest(
+    tel,
+    cfg,
+    topo,
+    result=None,
+    *,
+    backend: Optional[str] = None,
+    num_devices: int = 1,
+    resumed_from: Optional[str] = None,
+    resume_round: Optional[int] = None,
+    error: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Assemble the manifest document (pure; :func:`write_manifest` does
+    the I/O). ``result`` is None when the run died before finishing —
+    the manifest still lands with config + phases so the wreck is
+    diagnosable."""
+    import jax
+
+    from gossipprotocol_tpu import version as pkg_version
+    from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
+
+    doc: Dict[str, Any] = {
+        "v": SCHEMA_VERSION,
+        "kind": "run_manifest",
+        "package_version": pkg_version.__version__,
+        "jax_version": jax.__version__,
+        "backend": backend or jax.default_backend(),
+        "num_devices": int(num_devices),
+        "config": config_doc(cfg),
+        "topology": {
+            "kind": topo.kind,
+            "num_nodes": int(topo.num_nodes),
+            "num_directed_edges": int(topo.num_directed_edges),
+            "implicit_full": bool(topo.implicit_full),
+            "fingerprint": ckpt_mod.topology_fingerprint(topo),
+        },
+        "resume": (
+            {"from": resumed_from, "round": resume_round}
+            if resumed_from is not None else None
+        ),
+        "phases": tel.phase_rollup(),
+        "wall_s": round(tel.wall_s(), 6),
+        "counters": (dict(tel.totals) if tel.counters_on else None),
+        "max_mass_drift_ulps": (
+            tel.max_mass_drift_ulps if tel.counters_on else None
+        ),
+        "max_w_drift_ulps": (
+            tel.max_w_drift_ulps if tel.counters_on else None
+        ),
+    }
+    if result is not None:
+        err = result.estimate_error
+        doc["result"] = {
+            "converged": bool(result.converged),
+            "rounds": int(result.rounds),
+            "wall_ms": float(result.wall_ms),
+            "compile_ms": float(result.compile_ms),
+            "num_nodes": int(result.num_nodes),
+            "algorithm": result.algorithm,
+            "estimate_error": None if err is None else float(err),
+            "checkpoints": list(result.checkpoints),
+        }
+    if error is not None:
+        doc["error"] = error
+    return doc
+
+
+def write_manifest(tel, cfg, topo, result=None, **kw) -> Optional[str]:
+    """Write ``run.json`` into the telemetry dir (atomic tmp+rename).
+    No-op (returns None) when telemetry is off."""
+    if not tel.enabled or tel.dir is None:
+        return None
+    doc = build_manifest(tel, cfg, topo, result, **kw)
+    path = os.path.join(tel.dir, "run.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path

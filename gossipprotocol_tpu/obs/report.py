@@ -1,0 +1,251 @@
+"""Human-readable rendering of a telemetry dir.
+
+``python -m gossipprotocol_tpu report DIR`` reads what a ``--telemetry-dir``
+run left behind — ``run.json``, ``events.jsonl`` — and prints the
+summary you'd want before trusting (or debugging) the run: where the wall
+time went, what the counters totalled, how convergence progressed, and
+any anomaly the records can prove.
+
+Exit codes: 0 on success, 2 when DIR is missing/empty or the records
+carry a schema major version newer than this reader (absent ``"v"``
+means version 1 — see :mod:`gossipprotocol_tpu.utils.metrics`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, TextIO
+
+from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+class ReportError(Exception):
+    """Unreadable telemetry dir / incompatible schema — exit code 2."""
+
+
+def _check_version(doc: Dict[str, Any], where: str) -> None:
+    v = doc.get("v", 1)  # absent "v" IS version 1 by contract
+    if not isinstance(v, int) or v > SCHEMA_VERSION:
+        raise ReportError(
+            f"{where} has schema version {v!r}, but this reader understands "
+            f"up to {SCHEMA_VERSION}. Upgrade gossipprotocol_tpu to read it."
+        )
+
+
+def load_telemetry_dir(path: str) -> Dict[str, Any]:
+    """Read ``run.json`` + ``events.jsonl``; either may be absent (a run
+    killed before close still leaves partial events), both absent is an
+    error."""
+    manifest: Optional[Dict[str, Any]] = None
+    events: List[Dict[str, Any]] = []
+    mpath = os.path.join(path, "run.json")
+    epath = os.path.join(path, "events.jsonl")
+    if os.path.isfile(mpath):
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+        _check_version(manifest, mpath)
+    if os.path.isfile(epath):
+        with open(epath) as fh:
+            for i, line in enumerate(fh):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final line from a killed run
+                if i == 0:
+                    _check_version(rec, epath)
+                events.append(rec)
+    if manifest is None and not events:
+        raise ReportError(
+            f"no telemetry found under {path!r} (expected run.json and/or "
+            "events.jsonl — was the run launched with --telemetry-dir?)"
+        )
+    return {"manifest": manifest, "events": events}
+
+
+def sparkline(values: List[float], width: int = 40) -> str:
+    """Map a series onto ▁..█; downsamples to ``width`` by striding."""
+    if not values:
+        return ""
+    if len(values) > width:
+        stride = len(values) / width
+        values = [values[int(i * stride)] for i in range(width)]
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[-1] * len(values) if hi > 0 else _SPARK[0] * len(values)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1, int((v - lo) / span * (len(_SPARK) - 1)))]
+        for v in values
+    )
+
+
+def _phases_from_events(events: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Fallback rollup when run.json never landed (crashed run)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for rec in events:
+        if rec.get("kind") != "span" or rec.get("depth", 0) != 0:
+            continue
+        agg = out.setdefault(rec["name"], {"count": 0, "total_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += rec.get("dur_s", 0.0)
+    return out
+
+
+def _wall_from_events(events: List[Dict[str, Any]]) -> Optional[float]:
+    for rec in reversed(events):
+        if rec.get("kind") == "end":
+            return rec.get("wall_s")
+    last = 0.0
+    for rec in events:
+        if "start_s" in rec:
+            last = max(last, rec["start_s"] + rec.get("dur_s", 0.0))
+    return last or None
+
+
+def _metric_recs(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [r["rec"] for r in events if r.get("kind") == "metric" and "rec" in r]
+
+
+def anomaly_flags(manifest: Optional[Dict[str, Any]],
+                  metrics: List[Dict[str, Any]]) -> List[str]:
+    flags: List[str] = []
+    result = (manifest or {}).get("result")
+    if result is not None and not result.get("converged", True):
+        flags.append("DID NOT CONVERGE within the round budget")
+    if any(r.get("stalled") for r in metrics):
+        flags.append("gossip STALLED (live spreaders exhausted before quorum)")
+    peak_underflow = max((r.get("w_underflow", 0) or 0 for r in metrics),
+                        default=0)
+    if peak_underflow:
+        flags.append(
+            f"push-sum w-underflow: up to {peak_underflow} alive rows hit "
+            "w == 0 (dry-spell wall — consider f64)"
+        )
+    counters = (manifest or {}).get("counters")
+    if counters and counters.get("dropped", 0) > 0:
+        flags.append(f"{counters['dropped']} messages dropped by link loss")
+    drift = (manifest or {}).get("max_mass_drift_ulps")
+    wdrift = (manifest or {}).get("max_w_drift_ulps")
+    if drift is not None and max(drift, wdrift or 0.0) > 64.0:
+        flags.append(
+            f"push-sum mass drift up to {max(drift, wdrift or 0.0):.0f} ULPs "
+            "(large for the dtype — check loss windows / dtype choice)"
+        )
+    if manifest is None:
+        flags.append("run.json missing: run likely crashed before finishing")
+    return flags
+
+
+def render(data: Dict[str, Any], out: TextIO) -> None:
+    manifest = data["manifest"]
+    events = data["events"]
+    metrics = _metric_recs(events)
+
+    # header -------------------------------------------------------------
+    if manifest is not None:
+        cfg = manifest.get("config", {})
+        topo = manifest.get("topology", {})
+        out.write(
+            f"run: {cfg.get('algorithm', '?')} on {topo.get('kind', '?')}"
+            f"-{topo.get('num_nodes', '?')}  "
+            f"[{manifest.get('backend', '?')} x{manifest.get('num_devices', '?')}, "
+            f"gossipprotocol_tpu {manifest.get('package_version', '?')}, "
+            f"jax {manifest.get('jax_version', '?')}]\n"
+        )
+        if manifest.get("resume"):
+            r = manifest["resume"]
+            out.write(f"resumed: from {r.get('from')} at round {r.get('round')}\n")
+        result = manifest.get("result")
+        if result is not None:
+            err = result.get("estimate_error")
+            out.write(
+                f"result: {'converged' if result.get('converged') else 'NOT converged'}"
+                f" after {result.get('rounds')} rounds, "
+                f"{result.get('wall_ms', 0.0):.1f} ms run"
+                f" + {result.get('compile_ms', 0.0):.1f} ms compile"
+                + (f", estimate error {err:.3e}" if err is not None else "")
+                + "\n"
+            )
+
+    # phase table --------------------------------------------------------
+    phases = (manifest or {}).get("phases") or _phases_from_events(events)
+    wall = (manifest or {}).get("wall_s") or _wall_from_events(events)
+    if phases:
+        out.write("\nphases (host wall time):\n")
+        rows = sorted(phases.items(), key=lambda kv: -kv[1]["total_s"])
+        namew = max(len(n) for n, _ in rows)
+        covered = 0.0
+        for name, agg in rows:
+            covered += agg["total_s"]
+            pct = (100.0 * agg["total_s"] / wall) if wall else 0.0
+            out.write(
+                f"  {name:<{namew}}  {agg['total_s']:>9.3f} s"
+                f"  x{int(agg['count']):<5d} {pct:5.1f}%\n"
+            )
+        if wall:
+            out.write(
+                f"  {'(total)':<{namew}}  {covered:>9.3f} s of "
+                f"{wall:.3f} s wall ({100.0 * covered / wall:.1f}% covered)\n"
+            )
+
+    # counters -----------------------------------------------------------
+    counters = (manifest or {}).get("counters")
+    if counters:
+        out.write(
+            f"\nmessages: sent={counters.get('sent', 0)}"
+            f" delivered={counters.get('delivered', 0)}"
+            f" dropped={counters.get('dropped', 0)}\n"
+        )
+        drift = manifest.get("max_mass_drift_ulps")
+        if drift is not None:
+            out.write(
+                f"push-sum mass drift: |Σs| ≤ {drift:g} ULPs,"
+                f" |Σw − n| ≤ {manifest.get('max_w_drift_ulps', 0.0):g} ULPs\n"
+            )
+
+    # convergence sparkline ----------------------------------------------
+    if metrics:
+        frac = [
+            (r.get("converged", 0) / r["alive"]) if r.get("alive") else 0.0
+            for r in metrics
+        ]
+        first, last = metrics[0].get("round", "?"), metrics[-1].get("round", "?")
+        out.write(
+            f"\nconvergence (fraction of alive nodes, rounds {first}..{last}):\n"
+            f"  {sparkline(frac)}  {frac[-1] * 100:.1f}% final\n"
+        )
+
+    # anomalies ----------------------------------------------------------
+    flags = anomaly_flags(manifest, metrics)
+    if flags:
+        out.write("\nanomalies:\n")
+        for f in flags:
+            out.write(f"  ! {f}\n")
+    else:
+        out.write("\nanomalies: none\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m gossipprotocol_tpu report TELEMETRY_DIR",
+              file=sys.stderr if not argv else sys.stdout)
+        return 0 if argv else 2
+    path = argv[0]
+    if not os.path.isdir(path):
+        print(f"report: {path!r} is not a directory", file=sys.stderr)
+        return 2
+    try:
+        data = load_telemetry_dir(path)
+    except ReportError as e:
+        print(f"report: {e}", file=sys.stderr)
+        return 2
+    render(data, sys.stdout)
+    return 0

@@ -1,0 +1,279 @@
+"""Host-side span recorder: ``events.jsonl`` + Chrome-trace ``trace.json``.
+
+A :class:`Telemetry` instance is created by the CLI when ``--telemetry-dir``
+is set (or constructed directly by library callers, e.g. ``bench.py``) and
+threaded to the engine via ``RunConfig.telemetry``.  Everywhere else in the
+engine the accessor :func:`as_telemetry` turns ``None`` into the module
+singleton :data:`NULL` so call sites never branch on presence.
+
+Design constraints, in order:
+
+* **Crash-durable**: every span/event is appended to ``events.jsonl`` the
+  moment it closes (line-buffered), so a killed run still leaves a usable
+  record; ``trace.json`` is additionally written on :meth:`Telemetry.close`
+  and whenever ``write_trace`` is called.
+* **Cheap**: one ``time.perf_counter`` pair and one ``json.dumps`` per
+  span; no locks (the engine host loop is single-threaded), no buffering
+  of unbounded history beyond the finished-span list needed for the trace.
+* **Rollup-correct**: only *top-level* spans (depth 0) count toward the
+  per-phase wall-time rollup, so nesting ``checkpoint_save`` inside a
+  ``chunk`` span never double-counts.
+
+Every line in ``events.jsonl`` carries ``"v": 1`` (see
+:data:`gossipprotocol_tpu.utils.metrics.SCHEMA_VERSION`); readers must
+treat an absent ``"v"`` as version 1 and refuse higher major versions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+# Events/trace share the metrics record schema version: both are "run
+# telemetry records" and are read together by obs.report.
+from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
+
+COUNTER_TOTAL_FIELDS = ("sent", "delivered", "dropped")
+
+
+class _Span:
+    """Handle yielded by :meth:`Telemetry.span`; ``set()`` adds attrs late."""
+
+    __slots__ = ("name", "attrs", "depth", "t0", "start_s")
+
+    def __init__(self, name: str, attrs: Dict[str, Any], depth: int, t0: float, start_s: float):
+        self.name = name
+        self.attrs = attrs
+        self.depth = depth
+        self.t0 = t0
+        self.start_s = start_s
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+
+class Telemetry:
+    """Records host spans + run totals for one simulation run.
+
+    Parameters
+    ----------
+    out_dir:
+        Directory for ``events.jsonl`` / ``trace.json`` / ``run.json``
+        (created if missing).
+    counters:
+        When True (the CLI default) the engine also folds on-device
+        message counters through every chunk — a real (small) per-round
+        cost.  ``bench.py`` passes False: spans and manifest only, with
+        the compiled programs untouched.
+    """
+
+    enabled = True
+
+    def __init__(self, out_dir: str, *, counters: bool = True):
+        self.dir = os.path.abspath(out_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.counters_on = bool(counters)
+        self._t0 = time.perf_counter()
+        self._epoch0 = time.time()
+        self._depth = 0
+        self._finished: List[Dict[str, Any]] = []
+        self._closed = False
+        self.totals: Dict[str, int] = {k: 0 for k in COUNTER_TOTAL_FIELDS}
+        self.max_mass_drift_ulps = 0.0
+        self.max_w_drift_ulps = 0.0
+        self._events = open(os.path.join(self.dir, "events.jsonl"), "a", buffering=1)
+        self._emit({"kind": "start", "epoch_s": self._epoch0, "pid": os.getpid()})
+
+    # ---------------------------------------------------------------- spans
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[_Span]:
+        """Time a host-side phase; nested use is fine (depth is recorded)."""
+        sp = _Span(name, dict(attrs), self._depth, time.perf_counter(), 0.0)
+        sp.start_s = sp.t0 - self._t0
+        self._depth += 1
+        try:
+            yield sp
+        finally:
+            self._depth -= 1
+            dur = time.perf_counter() - sp.t0
+            rec = {
+                "kind": "span",
+                "name": sp.name,
+                "start_s": round(sp.start_s, 6),
+                "dur_s": round(dur, 6),
+                "depth": sp.depth,
+            }
+            if sp.attrs:
+                rec["attrs"] = sp.attrs
+            self._finished.append(rec)
+            self._emit(rec)
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Record an instant (zero-duration) host event."""
+        rec = {
+            "kind": "event",
+            "name": name,
+            "start_s": round(time.perf_counter() - self._t0, 6),
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        self._finished.append(rec)
+        self._emit(rec)
+
+    def metric(self, record: Dict[str, Any]) -> None:
+        """Mirror a per-chunk metrics record into ``events.jsonl``."""
+        self._emit({"kind": "metric", "rec": record})
+
+    # ------------------------------------------------------------- counters
+
+    def add_counters(self, sent: int, delivered: int, dropped: int) -> None:
+        self.totals["sent"] += int(sent)
+        self.totals["delivered"] += int(delivered)
+        self.totals["dropped"] += int(dropped)
+
+    def note_mass_drift(self, s_ulps: float, w_ulps: float) -> None:
+        self.max_mass_drift_ulps = max(self.max_mass_drift_ulps, float(s_ulps))
+        self.max_w_drift_ulps = max(self.max_w_drift_ulps, float(w_ulps))
+
+    # -------------------------------------------------------------- outputs
+
+    def wall_s(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def phase_rollup(self) -> Dict[str, Dict[str, float]]:
+        """Aggregate top-level spans by name: ``{name: {count, total_s}}``.
+
+        Depth > 0 spans are excluded so nested phases (a checkpoint save
+        inside a chunk) are counted exactly once, under their parent.
+        """
+        out: Dict[str, Dict[str, float]] = {}
+        for rec in self._finished:
+            if rec["kind"] != "span" or rec["depth"] != 0:
+                continue
+            agg = out.setdefault(rec["name"], {"count": 0, "total_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += rec["dur_s"]
+        for agg in out.values():
+            agg["total_s"] = round(agg["total_s"], 6)
+        return out
+
+    def write_trace(self, path: Optional[str] = None) -> str:
+        """Write Chrome trace event format (Perfetto / chrome://tracing)."""
+        path = path or os.path.join(self.dir, "trace.json")
+        events = []
+        for rec in self._finished:
+            ev: Dict[str, Any] = {
+                "name": rec["name"],
+                "cat": "host",
+                "pid": 1,
+                "tid": 1 + rec.get("depth", 0),
+                "ts": round(rec["start_s"] * 1e6, 3),
+            }
+            if rec["kind"] == "span":
+                ev["ph"] = "X"
+                ev["dur"] = round(rec["dur_s"] * 1e6, 3)
+            else:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            if rec.get("attrs"):
+                ev["args"] = rec["attrs"]
+            events.append(ev)
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"source": "gossipprotocol_tpu.obs", "v": SCHEMA_VERSION},
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+        return path
+
+    def close(self) -> None:
+        """Write ``trace.json`` and close ``events.jsonl``; idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.write_trace()
+            self._emit({"kind": "end", "wall_s": round(self.wall_s(), 6)})
+        finally:
+            self._events.close()
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        if self._events.closed:
+            return
+        rec = {"v": SCHEMA_VERSION, **rec}
+        self._events.write(json.dumps(rec) + "\n")
+
+
+class NullTelemetry:
+    """No-op stand-in used whenever telemetry is off.
+
+    Mirrors the full :class:`Telemetry` surface so engine code is written
+    once, unconditionally.  ``counters_on`` is False, which is what keeps
+    the compiled chunk programs bitwise identical to a telemetry-free
+    build (the counter fold is never installed).
+    """
+
+    enabled = False
+    counters_on = False
+    dir = None
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[_Span]:
+        yield _NULL_SPAN
+
+    def event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def metric(self, record: Dict[str, Any]) -> None:
+        pass
+
+    def add_counters(self, sent: int, delivered: int, dropped: int) -> None:
+        pass
+
+    def note_mass_drift(self, s_ulps: float, w_ulps: float) -> None:
+        pass
+
+    def wall_s(self) -> float:
+        return 0.0
+
+    def phase_rollup(self) -> Dict[str, Dict[str, float]]:
+        return {}
+
+    def write_trace(self, path: Optional[str] = None) -> Optional[str]:
+        return None
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullTelemetry":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        pass
+
+
+class _NullSpan:
+    def set(self, **attrs: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+NULL = NullTelemetry()
+
+
+def as_telemetry(obj: Any) -> Any:
+    """``RunConfig.telemetry`` accessor: ``None`` -> the no-op singleton."""
+    return NULL if obj is None else obj

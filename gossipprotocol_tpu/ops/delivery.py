@@ -274,6 +274,14 @@ class RoutedDelivery(NamedTuple):  # registered below: geometry static
 _register_delivery()
 
 
+def routed_streamed_bytes_per_round(rd: RoutedDelivery) -> int:
+    """Edge-stream f32 bytes one matvec moves through the class layout:
+    the interleaved ``[2 * m_pairs]`` slab (both expand output and
+    reduce input). Static plan geometry for the telemetry manifest —
+    single-chip routed rounds run no collectives."""
+    return 2 * int(rd.m_pairs) * 4
+
+
 def to_device(rd: RoutedDelivery) -> RoutedDelivery:
     """One-time upload of a host-built (or cache-loaded) delivery.
 

@@ -1221,6 +1221,70 @@ def pushsum_diffusion_round_routed_push(
     )
 
 
+def shard_routed_message_counts(
+    state,
+    shard_rd,  # ShardPushDelivery | ShardRoutedDelivery, [1, ...] slice
+    *,
+    design: str,
+    axis_name: str,
+    interpret: bool,
+    fast_alive: bool,
+    all_alive: bool,
+) -> jax.Array:
+    """Telemetry recount of one sharded routed round: int32 [sent,
+    delivered, dropped] over the LOCAL rows (obs/counters.py semantics;
+    the chunk body psums the vector).
+
+    Routed delivery rejects loss windows, so ``dropped`` is 0. On the
+    fast paths ``sent == delivered == Σ degree`` over live local rows.
+    Under an arbitrary dead set the recount repeats the round's
+    live-degree exchange (one extra collective matvec per round while a
+    fault plan is in force and telemetry is on — same cost shape as the
+    round's own general path).
+    """
+    rd = jax.tree.map(lambda x: x[0], shard_rd)  # drop the shard axis
+    deg = rd.degree.astype(jnp.float32)
+    if all_alive:
+        sent = _count_i32(jnp.sum(deg))
+        return jnp.stack([sent, sent, jnp.int32(0)])
+    live_rows = jnp.where(state.alive, deg, 0)
+    sent = _count_i32(jnp.sum(live_rows))
+    if fast_alive:
+        return jnp.stack([sent, sent, jnp.int32(0)])
+    alive_f = state.alive.astype(state.s.dtype)
+    if design == "push":
+        live_deg, _ = rd.matvec(alive_f, alive_f, axis_name=axis_name,
+                                interpret=interpret)
+    else:
+        fa = jax.lax.all_gather(alive_f, axis_name, tiled=True)
+        live_deg, _ = rd.matvec(fa, fa, interpret=interpret)
+    delivered = _count_i32(
+        jnp.sum(jnp.where(state.alive, live_deg, 0))
+    )
+    return jnp.stack([sent, delivered, jnp.int32(0)])
+
+
+def _count_i32(x) -> jax.Array:
+    """f32 message count -> int32, saturating."""
+    return jnp.clip(
+        x.astype(jnp.float32), 0.0, float(np.iinfo(np.int32).max)
+    ).astype(jnp.int32)
+
+
+def push_exchange_bytes_per_round(sd: ShardPushDelivery) -> int:
+    """Per-shard ``all_to_all`` payload of one push-design matvec: the
+    ``[num_shards, 2·block_pairs]`` f32 slab. One matvec per round on the
+    fast paths (two while a fault plan forces the live-degree pass) —
+    the telemetry manifest records this static figure."""
+    return int(sd.num_shards) * 2 * int(sd.block_pairs) * 4
+
+
+def pull_exchange_bytes_per_round(sd: ShardRoutedDelivery) -> int:
+    """Per-shard ``all_gather`` payload of one pull-design round: the two
+    full-length f32 share vectors every shard receives."""
+    return 2 * int(sd.n) * 4
+
+
 def pushsum_diffusion_round_routed_sharded(
     state,
     shard_rd: ShardRoutedDelivery,  # this device's slice (leading axis 1)

@@ -46,8 +46,10 @@ from gossipprotocol_tpu.engine.driver import (
     _drive,
     build_protocol,
     effective_keep_alive,
+    mass_stats,
     warm_start,
 )
+from gossipprotocol_tpu.obs import as_telemetry
 from gossipprotocol_tpu.parallel.mesh import (
     NODES_AXIS,
     make_mesh,
@@ -235,7 +237,7 @@ def pad_neighbors(nbrs, n_padded: int):
 
 def make_sharded_chunk_runner(
     topo: Topology, cfg: RunConfig, mesh: Mesh, allow_all_alive: bool = True,
-    nbrs_override=None,
+    nbrs_override=None, counter_slots: Optional[int] = None,
 ):
     """jitted ``(state, nbrs, seed, round_limit) -> state`` advancing one
     chunk under shard_map. Returns (runner, initial padded+placed state,
@@ -245,11 +247,19 @@ def make_sharded_chunk_runner(
     of the plan-cache path — the repair engine hands in incrementally
     *patched* plans here (ops/sharddelivery.py), which must never reach
     the cache: their capacities are forced to the pre-repair maxima, so
-    a cold build of the same topology would produce different tables."""
+    a cold build of the same topology would produce different tables.
+
+    ``counter_slots``: when ``cfg.telemetry`` has counters on, the rows
+    of the per-chunk message-counter buffer — must cover ``_drive``'s
+    chunk sizing for the *birth* topology (``run_simulation_sharded``
+    passes it; a repaired topology can resolve a different chunk size,
+    and a too-small buffer would silently clamp delta rows together).
+    Defaults to this topology's own resolved chunk size."""
     n = topo.num_nodes
     num_shards = int(mesh.devices.size)
     n_padded = padded_size(n, num_shards)
     local_n = n_padded // num_shards
+    tel = as_telemetry(cfg.telemetry)
 
     # build_protocol's flag pair is the single source of truth for the
     # liveness fast paths (padding rows are handled there via num_rows;
@@ -257,12 +267,27 @@ def make_sharded_chunk_runner(
     state0, _, done_fn, _, (all_alive, targets_alive) = build_protocol(
         topo, cfg, num_rows=n_padded, allow_all_alive=allow_all_alive
     )
+    platform = mesh.devices.flat[0].platform
     core = _sharded_core(
         topo, cfg, all_alive=all_alive, targets_alive=targets_alive,
-        platform=mesh.devices.flat[0].platform,
+        platform=platform,
     )
     is_pushsum = cfg.algorithm != "gossip"
     routed = is_pushsum and cfg.fanout == "all" and cfg.delivery == "routed"
+    psum_all = lambda x: jax.lax.psum(jnp.sum(x), NODES_AXIS)  # noqa: E731
+    counter_fn = None
+    if tel.counters_on:
+        from gossipprotocol_tpu.obs.counters import make_counter_fn
+
+        counter_fn = make_counter_fn(
+            topo, cfg, all_alive=all_alive, targets_alive=targets_alive,
+            all_sum=psum_all, interpret=(platform != "tpu"),
+            axis_name=NODES_AXIS,
+        )
+        if counter_slots is None:
+            counter_slots = cfg.resolve_chunk_rounds(
+                n, None if topo.implicit_full else int(topo.indices.size)
+            )
 
     def chunk_local(state_l, nbrs, seed, round_limit):
         base_key = jax.random.key(seed)
@@ -334,18 +359,48 @@ def make_sharded_chunk_runner(
                 unconv = jnp.sum((~s.converged & s.alive).astype(jnp.int32))
                 return jax.lax.psum(unconv, NODES_AXIS) == 0
 
-        def body(carry):
-            s, _ = carry
-            s = round_fn(s)
-            return s, global_done(s)
+        if counter_fn is None:
+            def body(carry):
+                s, _ = carry
+                s = round_fn(s)
+                return s, global_done(s)
 
-        def cond(carry):
-            s, done = carry
-            return jnp.logical_and(~done, s.round < round_limit)
+            def cond(carry):
+                s, done = carry
+                return jnp.logical_and(~done, s.round < round_limit)
 
-        final, done = jax.lax.while_loop(
-            cond, body, (state_l, global_done(state_l))
-        )
+            final, done = jax.lax.while_loop(
+                cond, body, (state_l, global_done(state_l))
+            )
+            buf = None
+        else:
+            # telemetry counters: per-round int32 deltas in a side buffer
+            # (row = round − chunk start). The counter fn re-derives the
+            # round's draws without touching state or PRNG stream, and the
+            # per-round psum replicates the deltas so the stats spec stays
+            # P(). The state trajectory is bitwise identical either way.
+            start = state_l.round
+
+            def body(carry):
+                s, _, buf = carry
+                alive_cnt = alive_g if alive_g is not None else s.alive
+                s2 = round_fn(s)
+                delta = jax.lax.psum(
+                    counter_fn(s, s2, nbrs, base_key, alive_cnt, gids),
+                    NODES_AXIS,
+                )
+                buf = jax.lax.dynamic_update_slice(
+                    buf, delta[None, :], (s.round - start, jnp.int32(0)))
+                return s2, global_done(s2), buf
+
+            def cond(carry):
+                s, done, _ = carry
+                return jnp.logical_and(~done, s.round < round_limit)
+
+            buf0 = jnp.zeros((counter_slots, 3), jnp.int32)
+            final, done, buf = jax.lax.while_loop(
+                cond, body, (state_l, global_done(state_l), buf0)
+            )
         # replicated on-device stats: one host fetch per chunk (mirrors
         # engine.driver.chunk_stats, with psum/pmin/pmax reductions)
         stats = {
@@ -379,6 +434,11 @@ def make_sharded_chunk_runner(
                 gossip_spreading_count(
                     final, effective_keep_alive(topo, cfg)), NODES_AXIS
             )
+        if counter_fn is not None:
+            stats["counters"] = buf  # already psum-replicated per round
+            # conservation scalars: same reduction for baseline and chunk
+            # (mass_stats docstring) — psum of local sums under shard_map
+            stats.update(mass_stats(final, all_sum=psum_all))
         return final, stats
 
     specs = _state_specs(state0)
@@ -386,16 +446,22 @@ def make_sharded_chunk_runner(
         if nbrs_override is not None:
             nbrs = nbrs_override
         else:
-            from gossipprotocol_tpu.ops import plancache
+            from gossipprotocol_tpu.ops import plancache, sharddelivery
 
             if cfg.routed_design == "push":
-                nbrs, _ = plancache.shard_push_deliveries_cached(
+                nbrs, prov = plancache.shard_push_deliveries_cached(
                     topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
                     build_workers=cfg.build_workers)
+                exch = sharddelivery.push_exchange_bytes_per_round(nbrs)
             else:
-                nbrs, _ = plancache.shard_deliveries_cached(
+                nbrs, prov = plancache.shard_deliveries_cached(
                     topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
                     build_workers=cfg.build_workers)
+                exch = sharddelivery.pull_exchange_bytes_per_round(nbrs)
+            tel.event(
+                "plan_cache", provenance=prov, design=cfg.routed_design,
+                num_shards=num_shards, exchange_bytes_per_round=exch,
+            )
         nbrs_sharded = True  # leading shard axis splits over the mesh
     elif is_pushsum and cfg.fanout == "all":
         # every leaf of the edge pytree is built as equal per-device
@@ -419,6 +485,10 @@ def make_sharded_chunk_runner(
         stats_fields += ["ratio_min", "ratio_max", "w_underflow"]
     else:
         stats_fields += ["spreading"]
+    if counter_fn is not None:
+        stats_fields += ["counters"]
+        if is_pushsum:
+            stats_fields += ["mass_s", "mass_w"]
     stats_specs = {k: P() for k in stats_fields}
     sm = shard_map(
         chunk_local,
@@ -483,21 +553,35 @@ def run_simulation_sharded(
     is_pushsum = cfg.algorithm != "gossip"
     routed = is_pushsum and cfg.fanout == "all" and cfg.delivery == "routed"
     routed_push = routed and cfg.routed_design == "push"
+    tel = as_telemetry(cfg.telemetry)
+    # counter-buffer rows must cover _drive's chunk sizing, which is
+    # computed from the BIRTH topology (run_topo may be a repair replay)
+    counter_slots = cfg.resolve_chunk_rounds(
+        n, None if topo.implicit_full else int(topo.indices.size)
+    )
     # for routed-push repair runs, hold the host-side stacked plans: the
     # incremental patcher splices rebuilt shards into them at repair events
     plans_host = None
     if routed_push:
-        from gossipprotocol_tpu.ops import plancache
+        from gossipprotocol_tpu.ops import plancache, sharddelivery
 
-        plans_host, _ = plancache.shard_push_deliveries_cached(
-            run_topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
-            build_workers=cfg.build_workers)
+        with tel.span("plan_compile", engine="sharded"):
+            plans_host, prov = plancache.shard_push_deliveries_cached(
+                run_topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
+                build_workers=cfg.build_workers)
+        tel.event(
+            "plan_cache", provenance=prov, design="push",
+            num_shards=num_shards,
+            exchange_bytes_per_round=(
+                sharddelivery.push_exchange_bytes_per_round(plans_host)),
+        )
 
-    runner, state, nbrs, done_fn, shardings = make_sharded_chunk_runner(
-        run_topo, cfg, mesh,
-        allow_all_alive=resume_allows_fast(topo, initial_state),
-        nbrs_override=plans_host,
-    )
+    with tel.span("topology_arrays", engine="sharded"):
+        runner, state, nbrs, done_fn, shardings = make_sharded_chunk_runner(
+            run_topo, cfg, mesh,
+            allow_all_alive=resume_allows_fast(topo, initial_state),
+            nbrs_override=plans_host, counter_slots=counter_slots,
+        )
     if initial_state is not None:
         # copy before placing: device_put of host numpy arrays is
         # zero-copy on CPU, and the chunk runner donates its inputs —
@@ -508,12 +592,14 @@ def run_simulation_sharded(
     seed = jnp.int32(cfg.seed)
 
     t0 = time.perf_counter()
-    compiled = runner.lower(state, nbrs, seed, jnp.int32(0)).compile()
+    with tel.span("jit_compile", engine="sharded"):
+        compiled = runner.lower(state, nbrs, seed, jnp.int32(0)).compile()
 
     def step(s, round_limit):
         return compiled(s, nbrs, seed, jnp.int32(round_limit))
 
-    state = warm_start(step, state)
+    with tel.span("warm_start"):
+        state = warm_start(step, state)
     compile_ms = (time.perf_counter() - t0) * 1e3
 
     def trim(s):
@@ -558,7 +644,7 @@ def run_simulation_sharded(
             info["plan_patch_s"] = time.perf_counter() - t0p
         runner2, _, nbrs2, _, _ = make_sharded_chunk_runner(
             new_topo, cfg, mesh, allow_all_alive=False,
-            nbrs_override=nbrs_over,
+            nbrs_override=nbrs_over, counter_slots=counter_slots,
         )
         compiled2 = runner2.lower(st, nbrs2, seed, jnp.int32(0)).compile()
 

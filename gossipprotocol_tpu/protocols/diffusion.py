@@ -280,6 +280,130 @@ def pushsum_diffusion_round_core(
     )
 
 
+_INT32_MAX_F = float(np.iinfo(np.int32).max)
+
+
+def _clip_count(x) -> jax.Array:
+    """f32 message count -> int32, saturating (implicit-full rounds can
+    exceed INT32_MAX messages above ~46k alive nodes)."""
+    return jnp.clip(
+        x.astype(jnp.float32), 0.0, _INT32_MAX_F
+    ).astype(jnp.int32)
+
+
+def diffusion_message_counts(
+    old: PushSumState,
+    nbrs: Optional[DiffusionEdges],
+    base_key: jax.Array,
+    *,
+    n: int,
+    gids,
+    all_alive: bool,
+    targets_alive: bool,
+    loss_windows: tuple,
+    alive_global,
+    all_sum=jnp.sum,
+) -> jax.Array:
+    """Telemetry recount of one fanout-all scatter round: int32 [sent,
+    delivered, dropped] over the local rows (obs/counters.py semantics).
+
+    Walks the same edge list with the same per-edge masks (validity,
+    target liveness, the (global src, global dst)-keyed drop mask) the
+    round applied — read-only, one extra pass over E per round while
+    telemetry is on. The implicit complete graph has no edges: every
+    alive node attempts ``a − 1`` sends and all land (loss is rejected
+    there by config), counted via ``all_sum`` and saturated to int32.
+    ``gids`` globalizes the local ``src`` ids under shard_map
+    (``row_offset = gids[0]``); None single-chip.
+    """
+    if nbrs is None:
+        dt = old.s.dtype
+        if all_alive:
+            local = jnp.asarray(old.s.shape[0], jnp.float32)
+            a = jnp.asarray(n, jnp.float32)
+        else:
+            local = jnp.sum(old.alive.astype(jnp.float32))
+            a = all_sum(old.alive.astype(jnp.float32))
+        del dt
+        cnt = _clip_count(local * jnp.maximum(a - 1.0, 0.0))
+        return jnp.stack([cnt, cnt, jnp.int32(0)])
+
+    src_alive = None if all_alive else old.alive[nbrs.src]
+    mask = nbrs.valid
+    if src_alive is not None:
+        mask = src_alive if mask is None else (mask & src_alive)
+    sent = (
+        jnp.asarray(nbrs.src.shape[0], jnp.int32) if mask is None
+        else jnp.sum(mask.astype(jnp.int32))
+    )
+    deliver = mask
+    if not (all_alive or targets_alive):
+        tgt_alive = alive_global[nbrs.dst]
+        deliver = tgt_alive if deliver is None else (deliver & tgt_alive)
+    if loss_windows:
+        from gossipprotocol_tpu.protocols.sampling import (
+            LOSS_FOLD, drop_mask, loss_probability,
+        )
+
+        key_loss = jax.random.fold_in(
+            jax.random.fold_in(base_key, old.round), LOSS_FOLD
+        )
+        p_loss = loss_probability(old.round, loss_windows)
+        row_offset = jnp.int32(0) if gids is None else gids[0]
+        keep = ~drop_mask(key_loss, p_loss, nbrs.src + row_offset, nbrs.dst)
+        if deliver is None:
+            dropped = jnp.sum((~keep).astype(jnp.int32))
+            deliver = keep
+        else:
+            dropped = jnp.sum((deliver & ~keep).astype(jnp.int32))
+            deliver = deliver & keep
+    else:
+        dropped = jnp.int32(0)
+    delivered = (
+        sent if deliver is None else jnp.sum(deliver.astype(jnp.int32))
+    )
+    return jnp.stack([sent, delivered, dropped])
+
+
+def routed_message_counts(
+    old: PushSumState,
+    routed,  # ops.delivery.RoutedDelivery
+    *,
+    n: int,
+    all_alive: bool,
+    targets_alive: bool,
+    interpret: bool = False,
+) -> jax.Array:
+    """Telemetry recount of one single-chip routed round (obs/counters.py).
+
+    Routed delivery ships one share per directed edge of a live sender
+    and rejects loss windows by config, so ``dropped`` is always 0 and
+    ``sent`` is Σ degree over live rows. ``delivered`` equals ``sent``
+    on the fast paths; under an arbitrary dead set the round already
+    recovers per-node live-neighbor counts algebraically with one extra
+    ``matvec(alive, alive)`` — the recount repeats it (doubling to two
+    extra matvecs per round while faults are in force and telemetry on).
+    """
+    dt = old.s.dtype
+    rows = old.s.shape[0]
+    deg = routed.degree.astype(dt)
+    if rows > n:
+        deg = jnp.pad(deg, (0, rows - n))
+    if all_alive:
+        sent = _clip_count(jnp.sum(deg))
+        return jnp.stack([sent, sent, jnp.int32(0)])
+    live_rows = jnp.where(old.alive, deg, 0)
+    sent = _clip_count(jnp.sum(live_rows))
+    if targets_alive:
+        return jnp.stack([sent, sent, jnp.int32(0)])
+    alive_f = old.alive.astype(dt)
+    live_deg, _ = routed.matvec(alive_f, alive_f, interpret=interpret)
+    delivered = _clip_count(
+        jnp.sum(jnp.where(old.alive, live_deg, 0))
+    )
+    return jnp.stack([sent, delivered, jnp.int32(0)])
+
+
 @partial(
     jax.jit,
     static_argnames=(

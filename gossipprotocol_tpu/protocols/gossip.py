@@ -209,6 +209,57 @@ def make_gossip_round(
     return round_fn
 
 
+def gossip_message_counts(
+    old: GossipState,
+    new: GossipState,
+    nbrs,
+    base_key: jax.Array,
+    *,
+    n: int,
+    gids,
+    keep_alive: bool,
+    all_alive: bool,
+    loss_windows: tuple = (),
+) -> jax.Array:
+    """Telemetry recount of one gossip round: int32 [sent, delivered,
+    dropped] over the local rows (obs/counters.py semantics).
+
+    Pure read-only derivation from the (old, new) state pair: ``sent`` is
+    the spreader set :func:`gossip_round_core` computed (re-derived from
+    ``old`` with the same static flags), ``delivered`` is ΣΔcounts — hits
+    actually credited, which is exact in *both* delivery branches (the
+    inverted histogram is bitwise-equal to the scatter's), and ``dropped``
+    re-draws the same loss mask from the same folded key. Sends suppressed
+    by a converged/dead receiver (the reference's dict check) count as
+    sent but not delivered — the gap is the protocol's wasted traffic.
+    """
+    from gossipprotocol_tpu.protocols.sampling import send_valid_mask
+
+    heard = old.counts >= 1
+    spreaders = heard if keep_alive else heard & ~old.converged
+    if not all_alive:
+        spreaders = spreaders & old.alive
+    valid = send_valid_mask(nbrs, n, gids)
+    sent_mask = spreaders if valid is None else spreaders & valid
+    sent = jnp.sum(sent_mask.astype(jnp.int32))
+    delivered = (
+        jnp.sum(new.counts.astype(jnp.int32))
+        - jnp.sum(old.counts.astype(jnp.int32))
+    )
+    if loss_windows:
+        key = jax.random.fold_in(base_key, old.round)
+        p_loss = loss_probability(old.round, loss_windows)
+        gid_rows = (
+            gids if gids is not None
+            else jnp.arange(old.counts.shape[0], dtype=jnp.int32)
+        )
+        drop = drop_mask(jax.random.fold_in(key, LOSS_FOLD), p_loss, gid_rows)
+        dropped = jnp.sum((sent_mask & drop).astype(jnp.int32))
+    else:
+        dropped = jnp.int32(0)
+    return jnp.stack([sent, delivered, dropped])
+
+
 def gossip_done(state: GossipState) -> jax.Array:
     """Supervisor predicate (reference: ``counter = nodes`` in the scheduler
     actor, ``Program.fs:53``): every healthy node has converged."""

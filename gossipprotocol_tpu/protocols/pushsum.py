@@ -357,6 +357,64 @@ def make_pushsum_round(
     return round_fn
 
 
+def pushsum_message_counts(
+    old: PushSumState,
+    nbrs,
+    base_key: jax.Array,
+    *,
+    n: int,
+    gids,
+    all_alive: bool,
+    targets_alive: bool,
+    delivery: str,
+    loss_windows: tuple,
+    alive_global: jax.Array,
+) -> jax.Array:
+    """Telemetry recount of one single-target push-sum round: int32
+    [sent, delivered, dropped] over the local rows (obs/counters.py).
+
+    Re-derives the round's draws through the same
+    :func:`~gossipprotocol_tpu.protocols.sampling.sample_neighbors` /
+    ``drop_mask`` calls :func:`pushsum_round_core` made — purely
+    read-only, so the state trajectory is untouched. ``sent`` counts live
+    senders with a valid draw; a half kept because the target was dead
+    is sent-not-delivered, one lost to a loss window is ``dropped`` (the
+    sender kept the mass either way — drops are mass-conserving).
+    """
+    key = jax.random.fold_in(base_key, old.round)
+
+    if delivery == "invert":
+        # invert is legal only while every send lands (no faults, no
+        # loss): sent == delivered by construction
+        from gossipprotocol_tpu.protocols.sampling import send_valid_mask
+
+        valid = send_valid_mask(nbrs, n, gids)
+        deliver = valid if all_alive else (valid & old.alive)
+        cnt = jnp.sum(deliver.astype(jnp.int32))
+        return jnp.stack([cnt, cnt, jnp.int32(0)])
+
+    targets, valid = sample_neighbors(nbrs, n, key, gids)
+    senders = valid if all_alive else (valid & old.alive)
+    sent = jnp.sum(senders.astype(jnp.int32))
+    if all_alive or targets_alive:
+        deliver = senders
+    else:
+        deliver = senders & alive_global[targets]
+    if loss_windows:
+        gid_rows = (
+            gids if gids is not None
+            else jnp.arange(old.s.shape[0], dtype=jnp.int32)
+        )
+        p = loss_probability(old.round, loss_windows)
+        drop = drop_mask(jax.random.fold_in(key, LOSS_FOLD), p, gid_rows)
+        dropped = jnp.sum((deliver & drop).astype(jnp.int32))
+        deliver = deliver & ~drop
+    else:
+        dropped = jnp.int32(0)
+    delivered = jnp.sum(deliver.astype(jnp.int32))
+    return jnp.stack([sent, delivered, dropped])
+
+
 def pushsum_done(state: PushSumState) -> jax.Array:
     """Supervisor predicate: every healthy node's estimate has stabilized."""
     return jnp.all(state.converged | ~state.alive)

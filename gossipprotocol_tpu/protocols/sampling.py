@@ -329,6 +329,27 @@ def sample_neighbors(
     return jnp.where(valid, targets, 0), valid
 
 
+def send_valid_mask(nbrs, n: int, gids: Optional[jax.Array] = None):
+    """Which local rows *can* emit a message (degree > 0 and a real id).
+
+    The telemetry counter functions (obs/counters.py) share this with no
+    other purpose: it restates :func:`sample_neighbors`'s ``valid`` output
+    without materializing targets, for branches that only need the count.
+    Returns None for the single-chip implicit complete graph, where every
+    row is statically valid (callers use the row count directly).
+    """
+    if isinstance(nbrs, (DenseNeighbors, InvertedDense)):
+        valid = nbrs.degree > 0
+        return valid if gids is None else (valid & (gids < n))
+    if nbrs is None:
+        return None if gids is None else (gids < n)
+    # CSRNeighbors: degree is global-length and replicated
+    if gids is None:
+        return nbrs.degree > 0
+    safe = jnp.minimum(gids, n - 1)
+    return (gids < n) & (nbrs.degree[safe] > 0)
+
+
 def make_neighbor_sampler(topo: Topology):
     """Closure convenience (tests / notebooks): ``sample(key) -> (targets,
     valid)`` with the device arrays bound."""

@@ -1,0 +1,291 @@
+"""Unified run telemetry (obs/): the zero-cost and ride-alongside contracts.
+
+The load-bearing claims, each asserted here:
+
+* telemetry OFF -> the engines run the literal pre-telemetry code path,
+  so results are bitwise identical to a build without obs/;
+* telemetry ON -> convergence is STILL bitwise identical (counters ride a
+  side buffer through the chunk scan and never feed back into state);
+* the counters themselves are right (closed-form oracles on line graphs,
+  single-chip == sharded, sent == delivered + dropped under link loss);
+* push-sum mass drift is exactly 0 ULPs for a dyadic config with no loss;
+* the artifacts are usable: trace.json is a valid Chrome trace, run.json
+  carries the config/counters/phases, and the ``report`` subcommand
+  renders them with the documented exit codes and a phase rollup that
+  accounts for ~all of the wall time.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.cli import main as cli_main
+from gossipprotocol_tpu.obs import Telemetry
+from gossipprotocol_tpu.obs.report import main as report_main, sparkline
+from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+from gossipprotocol_tpu.utils.faults import FaultSchedule, LossWindow
+from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION, JsonlMetricsWriter
+
+# keys the telemetry path ADDS to metrics records; everything else must
+# be identical with telemetry on vs off
+TELEMETRY_KEYS = {"v", "sent", "delivered", "dropped",
+                  "mass_drift_ulps", "w_drift_ulps"}
+
+
+def strip_telemetry(recs):
+    return [{k: v for k, v in r.items() if k not in TELEMETRY_KEYS}
+            for r in recs]
+
+
+def leaves_bytes(state):
+    """Bitwise view of a state pytree for exact equality checks."""
+    return [np.asarray(leaf).tobytes() for leaf in jax.tree.leaves(state)]
+
+
+def run_pair(topo, tmp_path, sharded=False, cpu_devices=None, **cfg_kw):
+    """Run the same config with telemetry off and on; return both results
+    plus the (closed) Telemetry hub."""
+    cfg_off = RunConfig(**cfg_kw)
+    tel = Telemetry(str(tmp_path / "tel"))
+    cfg_on = RunConfig(telemetry=tel, **cfg_kw)
+    if sharded:
+        mesh = make_mesh(devices=cpu_devices[:2])
+        r_off = run_simulation_sharded(topo, cfg_off, mesh=mesh)
+        r_on = run_simulation_sharded(topo, cfg_on, mesh=mesh)
+    else:
+        r_off = run_simulation(topo, cfg_off)
+        r_on = run_simulation(topo, cfg_on)
+    tel.close()
+    return r_off, r_on, tel
+
+
+def assert_bitwise_equal(r_off, r_on):
+    assert r_on.rounds == r_off.rounds
+    assert r_on.converged == r_off.converged
+    for a, b in zip(leaves_bytes(r_off.final_state),
+                    leaves_bytes(r_on.final_state)):
+        assert a == b, "telemetry changed the state trajectory"
+    assert strip_telemetry(r_on.metrics) == strip_telemetry(r_off.metrics)
+
+
+@pytest.mark.parametrize("algorithm", ["gossip", "push-sum"])
+def test_bitwise_invariance_single_chip(algorithm, tmp_path):
+    topo = build_topology("line", 32, seed=0)
+    r_off, r_on, tel = run_pair(
+        topo, tmp_path, algorithm=algorithm, seed=3, max_rounds=400)
+    assert_bitwise_equal(r_off, r_on)
+    # and the telemetry run actually counted something
+    assert tel.totals["sent"] > 0
+    assert tel.totals["delivered"] > 0
+
+
+@pytest.mark.parametrize("algorithm", ["gossip", "push-sum"])
+def test_bitwise_invariance_sharded(algorithm, tmp_path, cpu_devices):
+    topo = build_topology("line", 32, seed=0)
+    r_off, r_on, tel = run_pair(
+        topo, tmp_path, sharded=True, cpu_devices=cpu_devices,
+        algorithm=algorithm, seed=3, max_rounds=400)
+    assert_bitwise_equal(r_off, r_on)
+    assert tel.totals["sent"] > 0
+
+
+def test_counters_oracle_pushsum_fanout_one(tmp_path):
+    """All-alive lossless fanout-one push-sum: every node sends exactly
+    one message per round and every message lands."""
+    n = 16
+    topo = build_topology("line", n, seed=0)
+    _, r_on, tel = run_pair(
+        topo, tmp_path, algorithm="push-sum", seed=1, max_rounds=600)
+    assert tel.totals["sent"] == n * r_on.rounds
+    assert tel.totals["delivered"] == n * r_on.rounds
+    assert tel.totals["dropped"] == 0
+
+
+def test_counters_oracle_diffusion_fanout_all(tmp_path):
+    """All-alive lossless diffusion: each round walks every directed
+    edge exactly once — sent == num_directed_edges * rounds."""
+    n = 16
+    topo = build_topology("line", n, seed=0)
+    _, r_on, tel = run_pair(
+        topo, tmp_path, algorithm="push-sum", fanout="all", seed=1,
+        max_rounds=600)
+    edges = topo.num_directed_edges  # 2*(n-1) on a line
+    assert tel.totals["sent"] == edges * r_on.rounds
+    assert tel.totals["delivered"] == edges * r_on.rounds
+    assert tel.totals["dropped"] == 0
+
+
+def test_counters_sharded_match_single_chip(tmp_path, cpu_devices):
+    topo = build_topology("line", 24, seed=0)
+    kw = dict(algorithm="gossip", seed=7, max_rounds=400)
+    _, _, tel1 = run_pair(topo, tmp_path / "a", **kw)
+    _, _, tel2 = run_pair(topo, tmp_path / "b", sharded=True,
+                          cpu_devices=cpu_devices, **kw)
+    assert tel2.totals == tel1.totals
+
+
+def test_mass_drift_zero_ulps_dyadic_lossless(tmp_path):
+    """value_mode='index' on a power-of-two line keeps every (s, w) sum
+    exactly representable: conservation must hold to the last bit."""
+    topo = build_topology("line", 64, seed=0)
+    _, _, tel = run_pair(
+        topo, tmp_path, algorithm="push-sum", value_mode="index", seed=3,
+        max_rounds=300)
+    assert tel.max_mass_drift_ulps == 0.0
+    assert tel.max_w_drift_ulps == 0.0
+
+
+def test_loss_counters_conserve_and_drop(tmp_path):
+    """Under link loss: dropped > 0, and every attempted send is
+    accounted for — sent == delivered + dropped (drops are the ONLY
+    reason an all-alive send can miss)."""
+    topo = build_topology("line", 32, seed=0)
+    sched = FaultSchedule(loss=(LossWindow(0, 10_000, 0.3),))
+    _, _, tel = run_pair(
+        topo, tmp_path, algorithm="push-sum", seed=5, max_rounds=800,
+        fault_schedule=sched)
+    assert tel.totals["dropped"] > 0
+    assert tel.totals["sent"] == tel.totals["delivered"] + tel.totals["dropped"]
+
+
+def test_trace_json_is_valid_chrome_trace(tmp_path):
+    topo = build_topology("line", 16, seed=0)
+    run_pair(topo, tmp_path, algorithm="gossip", seed=0, max_rounds=400)
+    with open(tmp_path / "tel" / "trace.json") as fh:
+        doc = json.load(fh)
+    events = doc["traceEvents"]
+    assert events, "trace has no events"
+    names = set()
+    for ev in events:
+        assert ev["ph"] in ("X", "i")
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert "pid" in ev and "tid" in ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+        names.add(ev["name"])
+    # the phases the tentpole promises are actually traced
+    for expected in ("jit_compile", "chunk", "device_sync"):
+        assert expected in names
+
+
+def test_events_jsonl_versioned(tmp_path):
+    topo = build_topology("line", 16, seed=0)
+    run_pair(topo, tmp_path, algorithm="gossip", seed=0, max_rounds=400)
+    with open(tmp_path / "tel" / "events.jsonl") as fh:
+        first = json.loads(fh.readline())
+    assert first["v"] == SCHEMA_VERSION
+
+
+# ---------------------------------------------------------------- CLI/report
+
+
+def run_cli(args, capsys):
+    code = cli_main(args)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+def test_cli_telemetry_run_and_report(tmp_path, capsys):
+    """End-to-end: --telemetry-dir leaves a complete dir, `report` renders
+    it (exit 0), and the phase rollup accounts for >= 90% of the wall."""
+    tdir = str(tmp_path / "tel")
+    code, out, err = run_cli(
+        ["48", "line", "push-sum", "--seed", "2", "--max-rounds", "500",
+         "--telemetry-dir", tdir,
+         "--metrics-out", str(tmp_path / "m.jsonl")], capsys)
+    assert code == 0, err
+    for fname in ("run.json", "events.jsonl", "trace.json"):
+        assert os.path.isfile(os.path.join(tdir, fname)), fname
+
+    with open(os.path.join(tdir, "run.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["v"] == SCHEMA_VERSION
+    assert manifest["config"]["algorithm"] == "push-sum"
+    assert manifest["result"]["converged"] is True
+    assert manifest["counters"]["sent"] > 0
+    covered = sum(p["total_s"] for p in manifest["phases"].values())
+    assert covered >= 0.9 * manifest["wall_s"], (
+        f"phase rollup covers only {covered / manifest['wall_s']:.0%} "
+        "of the wall time"
+    )
+
+    # stamped metrics: every record carries the schema version
+    with open(tmp_path / "m.jsonl") as fh:
+        recs = [json.loads(line) for line in fh]
+    assert recs and all(r.get("v") == SCHEMA_VERSION for r in recs)
+
+    code = report_main([tdir])
+    out = capsys.readouterr().out
+    assert code == 0
+    for needle in ("run: push-sum on line-48", "result: converged",
+                   "phases (host wall time)", "messages: sent=",
+                   "convergence", "anomalies"):
+        assert needle in out, f"report output missing {needle!r}:\n{out}"
+
+
+def test_report_exit_codes(tmp_path, capsys):
+    # missing dir
+    assert report_main([str(tmp_path / "nope")]) == 2
+    assert "not a directory" in capsys.readouterr().err
+    # empty dir (no telemetry files)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert report_main([str(empty)]) == 2
+    assert "--telemetry-dir" in capsys.readouterr().err
+    # future schema version refused loudly
+    newer = tmp_path / "newer"
+    newer.mkdir()
+    (newer / "run.json").write_text(json.dumps({"v": SCHEMA_VERSION + 1}))
+    assert report_main([str(newer)]) == 2
+    err = capsys.readouterr().err
+    assert "schema version" in err and "Upgrade" in err
+
+
+def test_report_anomaly_flags(tmp_path, capsys):
+    """Loss run: report must surface the dropped-message anomaly."""
+    tdir = str(tmp_path / "tel")
+    code, _, err = run_cli(
+        ["32", "line", "push-sum", "--seed", "5", "--max-rounds", "600",
+         "--drop-prob", "0.3", "--telemetry-dir", tdir, "--quiet"], capsys)
+    assert code == 0, err
+    assert report_main([tdir]) == 0
+    out = capsys.readouterr().out
+    assert "dropped by link loss" in out
+
+
+def test_sparkline():
+    assert sparkline([]) == ""
+    assert len(sparkline([0.0] * 100, width=40)) == 40
+    s = sparkline([0.0, 0.5, 1.0])
+    assert s[0] == "▁" and s[-1] == "█"
+
+
+# ------------------------------------------------------------ metrics writer
+
+
+def test_writer_context_manager_closes_on_error(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with pytest.raises(RuntimeError):
+        with JsonlMetricsWriter(path) as w:
+            w({"round": 1})
+            raise RuntimeError("boom")
+    # the record written before the error is durable
+    with open(path) as fh:
+        assert json.loads(fh.readline()) == {"round": 1}
+    w.close()  # idempotent
+
+
+def test_writer_stamping_and_append(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with JsonlMetricsWriter(path, stamp_version=True) as w:
+        w({"round": 1})
+    with JsonlMetricsWriter(path, mode="a") as w:  # resume contract
+        w({"round": 2})
+    with open(path) as fh:
+        recs = [json.loads(line) for line in fh]
+    assert recs[0] == {"v": SCHEMA_VERSION, "round": 1}
+    assert recs[1] == {"round": 2}  # unstamped: absent "v" means v1
