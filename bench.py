@@ -98,6 +98,34 @@ def main():
     )
     ref_1k_ms = 1150.0  # F# baseline, Report.pdf p.1 (red line @1000)
 
+    # --- vector-payload point: d=32 push-sum diffusion -------------------
+    # The decentralized-learning payload width in the acceptance range:
+    # 32 payload columns + the w stream through the same delivery the
+    # scalar protocol compiles. Recoverable-failure guarded like the 10M
+    # point — a vector regression must not discard the headline.
+    aux_vec = {}
+    try:
+        n_vec = int(os.environ.get("BENCH_VEC_NODES", 100_000))
+        topo_vec = build_topology("imp3D", n_vec, seed=0)
+        res_vec = run_simulation(
+            topo_vec,
+            RunConfig(algorithm="push-sum", seed=0, payload_dim=32,
+                      fanout="all", predicate="global", tol=1e-4,
+                      chunk_rounds=64, max_rounds=4096),
+        )
+        assert res_vec.converged, (
+            f"vector run did not converge: {res_vec.rounds}"
+        )
+        aux_vec = {
+            "aux_vec32_s": round(res_vec.wall_ms / 1e3, 4),
+            "aux_vec32_rounds": res_vec.rounds,
+            "aux_vec32_nodes": topo_vec.num_nodes,
+            "aux_vec32_payload_dim": 32,
+            "aux_vec32_compile_s": round(res_vec.compile_ms / 1e3, 2),
+        }
+    except Exception as e:  # noqa: BLE001
+        aux_vec = {"aux_vec32_error": f"{type(e).__name__}: {e}"[:200]}
+
     # --- north-star scale: 10M-node imp3D gossip (BASELINE.md: <60 s on a
     # v5e-8; measured here on ONE chip). Recorded, not just claimed
     # (README's 34 s figure). Budget-guarded; skippable for quick local
@@ -117,6 +145,7 @@ def main():
         # compile, chunks) + where the full manifest/trace landed
         "phase_s": phase_s,
         "telemetry_dir": tel_dir,
+        **aux_vec,
     }
     # backup record on stderr BEFORE the 10M attempt: a process-fatal 10M
     # failure (OOM-killer, watchdog SIGKILL) must not lose the measured

@@ -44,7 +44,7 @@ from gossipprotocol_tpu.protocols import (
     pushsum_init,
 )
 from gossipprotocol_tpu.protocols.gossip import gossip_round
-from gossipprotocol_tpu.protocols.pushsum import pushsum_round
+from gossipprotocol_tpu.protocols.pushsum import pushsum_round, sum0
 from gossipprotocol_tpu.protocols.sampling import device_topology
 from gossipprotocol_tpu.topology.base import Topology
 
@@ -111,6 +111,29 @@ class RunConfig:
                                    # routed delivery
                                    # (tests/test_pushdelivery.py)
     value_mode: str = "scaled"     # push-sum init: "scaled" (i/N) | "index" (i)
+    payload_dim: int = 1           # push-sum payload width d: 1 = the
+                                   # scalar (s, w) protocol (bitwise the
+                                   # pre-vector program); d > 1 rides an
+                                   # [n, d] payload through the same
+                                   # delivery plans (w stays per-node)
+    workload: str = "avg"          # "avg" (plain averaging) | "sgp"
+                                   # (Stochastic Gradient Push on a
+                                   # synthetic least-squares shard per
+                                   # node; learn/ package)
+    accel: str = "off"             # push-sum fanout-all acceleration:
+                                   # "off" | "chebyshev" (semi-iterative
+                                   # weights, needs a spectral bound) |
+                                   # "epd" (parameter-free two-buffer
+                                   # scheme) — protocols/accel.py
+    accel_lambda: Optional[float] = None  # Chebyshev γ = |λ₂(W)| bound in
+                                   # (0, 1); None = host power-iteration
+                                   # estimate at build time
+    lr: float = 0.05               # SGP local gradient step size
+    local_steps: int = 1           # SGP gradient steps per gossip round
+    sgp_samples: int = 8           # SGP least-squares rows per node shard
+    loss_tol: float = 1e-5         # SGP loss-plateau tolerance: converge
+                                   # only when |Δ mean loss| <= loss_tol
+                                   # on top of the consensus predicate
     dtype: Any = jnp.float32
     max_rounds: int = 1_000_000
     # rounds per jitted call / metrics cadence; None = auto-scale by node
@@ -267,6 +290,89 @@ class RunConfig:
                     "senders' draws without checking liveness or loss); "
                     "drop the fault schedule or use delivery='scatter'"
                 )
+        if self.payload_dim < 1:
+            raise ValueError("payload_dim must be >= 1")
+        if self.payload_dim > 1:
+            if self.algorithm != "push-sum" or self.semantics == "reference":
+                raise ValueError(
+                    "payload_dim > 1 rides push-sum's (s, w) state under "
+                    "intended semantics; gossip and the reference replay "
+                    "are scalar protocols"
+                )
+            if self.delivery == "invert":
+                raise ValueError(
+                    "delivery='invert' recomputes senders' scalar draws "
+                    "and is scalar-payload only; use 'scatter' or 'routed' "
+                    "for payload_dim > 1"
+                )
+        if self.workload not in ("avg", "sgp"):
+            raise ValueError("workload must be 'avg' or 'sgp'")
+        if self.accel not in ("off", "chebyshev", "epd"):
+            raise ValueError("accel must be 'off', 'chebyshev', or 'epd'")
+        if self.lr <= 0:
+            raise ValueError("lr must be > 0")
+        if self.local_steps < 1:
+            raise ValueError("local_steps must be >= 1")
+        if self.sgp_samples < 1:
+            raise ValueError("sgp_samples must be >= 1")
+        if self.loss_tol <= 0:
+            raise ValueError("loss_tol must be > 0")
+        if self.workload == "sgp":
+            if self.algorithm != "push-sum" or self.semantics == "reference":
+                raise ValueError(
+                    "workload='sgp' is Stochastic Gradient *Push*: it "
+                    "requires algorithm='push-sum' with intended semantics"
+                )
+            if self.predicate != "global":
+                raise ValueError(
+                    "workload='sgp' certifies consensus distance, which is "
+                    "the 'global' predicate; the local 'delta' rule would "
+                    "fire while gradients still move the mean"
+                )
+            if self.accel != "off":
+                raise ValueError(
+                    "workload='sgp' re-injects mass every round (gradient "
+                    "steps); the accelerated two-buffer schemes assume a "
+                    "fixed linear iteration — run them on workload='avg'"
+                )
+            if self.delivery != "scatter":
+                raise ValueError(
+                    "workload='sgp' supports delivery='scatter' (the "
+                    "routed plans' pair packing is tuned for the averaging "
+                    "payload; invert is scalar-only)"
+                )
+        if self.accel != "off":
+            if self.algorithm != "push-sum" or self.fanout != "all":
+                raise ValueError(
+                    "accel applies to fanout-all diffusion push-sum: the "
+                    "polynomial schemes accelerate a fixed mixing matrix W, "
+                    "which only the diffusion sender applies"
+                )
+            if self.delivery != "scatter":
+                raise ValueError(
+                    "accel currently runs on delivery='scatter' (the "
+                    "two-buffer combination wraps the scatter diffusion "
+                    "mix)"
+                )
+            if sched:
+                raise ValueError(
+                    "accel assumes a *fixed* mixing matrix: Chebyshev/EPD "
+                    "coefficient schedules are invalid the moment a strike "
+                    "or loss window rewrites W mid-run; drop the fault "
+                    "schedule or use accel='off'"
+                )
+            if self.repair != "off":
+                raise ValueError(
+                    "accel assumes a fixed mixing matrix; repair rewrites "
+                    "the adjacency mid-run"
+                )
+        if self.accel_lambda is not None and not (
+            0.0 < self.accel_lambda < 1.0
+        ):
+            raise ValueError(
+                "accel_lambda is a spectral bound γ = |λ₂(W)| and must lie "
+                "strictly in (0, 1)"
+            )
 
     def resolve_chunk_rounds(
         self, num_nodes: int, num_edges: Optional[int] = None
@@ -337,14 +443,16 @@ class RunResult:
         alive = np.asarray(st.alive)
         if not alive.any():
             return None
-        s = np.asarray(st.s, np.float64)[alive].sum()
+        # axis=0 keeps this exact for vector payloads: s is [k] or [k, d],
+        # the sum is a scalar or per-dimension [d] mean respectively
+        s = np.asarray(st.s, np.float64)[alive].sum(axis=0)
         w = np.asarray(st.w, np.float64)[alive].sum()
         if hasattr(st, "msg_s"):
             # the walk's in-flight token carries real mass (its holder is
             # always an alive node); the reachable mean includes it
-            s += float(st.msg_s)
+            s = s + float(st.msg_s)
             w += float(st.msg_w)
-        true_mean = float(s / w)
+        true_mean = s / w
         return float(np.abs(ratio[alive] - true_mean).max())
 
 
@@ -452,8 +560,37 @@ def build_protocol(
             state = pushsum_init(
                 rows, value_mode=cfg.value_mode, dtype=cfg.dtype,
                 reference_semantics=ref, real_nodes=n,
+                payload_dim=cfg.payload_dim,
             )
-        if cfg.fanout == "all":
+        if cfg.accel != "off":
+            from gossipprotocol_tpu.protocols.accel import (
+                accel_init,
+                accel_round,
+                estimate_gamma,
+            )
+
+            state = accel_init(
+                rows, value_mode=cfg.value_mode, dtype=cfg.dtype,
+                real_nodes=n, payload_dim=cfg.payload_dim,
+            )
+            gamma = 0.0
+            if cfg.accel == "chebyshev":
+                gamma = (cfg.accel_lambda if cfg.accel_lambda is not None
+                         else estimate_gamma(topo))
+            core = partial(
+                accel_round,
+                n=n,
+                variant=cfg.accel,
+                gamma=float(gamma),
+                eps=cfg.eps,
+                streak_target=cfg.streak_target,
+                predicate=cfg.predicate,
+                tol=cfg.tol,
+                all_alive=all_alive,
+                targets_alive=targets_alive,
+                edge_chunks=cfg.edge_chunks,
+            )
+        elif cfg.fanout == "all":
             from gossipprotocol_tpu.protocols.diffusion import (
                 pushsum_diffusion_round,
                 pushsum_diffusion_round_routed,
@@ -563,8 +700,23 @@ def build_protocol(
                 delivery=cfg.delivery,
                 loss_windows=loss_windows,
             )
+        if cfg.workload == "sgp":
+            from gossipprotocol_tpu.learn import make_sgp_core, sgp_init
+
+            # the mixing core above is reused verbatim; only the state
+            # swaps (x₀ = 0 plus the loss scalar) and the round gains the
+            # local gradient step + loss-plateau gate. The SGPBundle data
+            # rides the nbrs slot — see device_arrays.
+            state = sgp_init(
+                rows, cfg.payload_dim, dtype=cfg.dtype, real_nodes=n)
+            core = make_sgp_core(
+                core, lr=cfg.lr, local_steps=cfg.local_steps,
+                loss_tol=cfg.loss_tol,
+            )
         done_fn = pushsum_done
         extra_stats = None
+        if cfg.workload == "sgp":
+            extra_stats = lambda s: {"train_loss": s.loss}  # noqa: E731
 
     if alive0 is not None:
         if rows > n:
@@ -684,7 +836,22 @@ def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
     ``tel`` (an :mod:`~gossipprotocol_tpu.obs` telemetry hub or None)
     receives the routed plan's cache provenance — whether the tables were
     loaded (``hit``), compiled (``miss``), or built uncached (``off``).
+
+    For ``workload='sgp'`` the per-node least-squares shard rides along in
+    an :class:`~gossipprotocol_tpu.learn.SGPBundle` wrapping the delivery
+    pytree — same slot, so the chunk runner and ``shard_map`` specs treat
+    data rows exactly like neighbor rows.
     """
+    if cfg.algorithm == "push-sum" and cfg.workload == "sgp":
+        from gossipprotocol_tpu.learn import SGPBundle, make_least_squares
+
+        inner_cfg = dataclasses.replace(cfg, workload="avg")
+        inner = device_arrays(topo, inner_cfg, tel)
+        a, b, _ = make_least_squares(
+            topo.num_nodes, cfg.payload_dim, cfg.sgp_samples, cfg.seed,
+            dtype=np.dtype(jnp.dtype(cfg.dtype).name),
+        )
+        return SGPBundle(nbrs=inner, A=jnp.asarray(a), b=jnp.asarray(b))
     if cfg.algorithm == "push-sum" and cfg.fanout == "all":
         if cfg.delivery == "routed":
             from gossipprotocol_tpu.ops.delivery import (
@@ -744,8 +911,11 @@ def chunk_stats(state, done_fn) -> dict:
     }
     if hasattr(state, "ratio"):  # PushSumState and the reference WalkState
         big = jnp.asarray(jnp.inf, state.ratio.dtype)
-        rec["ratio_min"] = jnp.min(jnp.where(state.alive, state.ratio, big))
-        rec["ratio_max"] = jnp.max(jnp.where(state.alive, state.ratio, -big))
+        # vector payloads: broadcast the per-node mask over the d columns
+        live = (state.alive if state.ratio.ndim == 1
+                else state.alive[:, None])
+        rec["ratio_min"] = jnp.min(jnp.where(live, state.ratio, big))
+        rec["ratio_max"] = jnp.max(jnp.where(live, state.ratio, -big))
         # dry-spell underflow detector (the measured 100M f32 wall): an
         # alive node with w == 0 has halved through the float subnormals
         # during a receipt dry spell — its ratio is garbage and the
@@ -764,7 +934,7 @@ def stats_with_extra(state, done_fn, extra_stats) -> dict:
     return rec
 
 
-def mass_stats(state, all_sum=jnp.sum) -> dict:
+def mass_stats(state, all_sum=sum0) -> dict:
     """On-device conservation scalars for the telemetry counters: total
     push-sum mass ``(Σs, Σw)`` over every row, in the state dtype. The
     walk's in-flight token carries real mass, so it is included. Empty
@@ -774,8 +944,13 @@ def mass_stats(state, all_sum=jnp.sum) -> dict:
     The drift baseline is taken from the *same compiled reduction* (a
     no-op ``step(state, -1)`` at drive start), so a lossless run reports
     exactly 0 ULPs — comparing against an eager host sum would
-    manufacture drift out of reduction-order rounding."""
-    if not hasattr(state, "s"):
+    manufacture drift out of reduction-order rounding.
+
+    Vector payloads report per-dimension mass (``mass_s`` is a [d]
+    vector); the drift tracker takes the max over dimensions. SGP states
+    are excluded entirely — the gradient step injects mass by design, so
+    "drift" would only measure the optimizer."""
+    if not hasattr(state, "s") or hasattr(state, "loss"):
         return {}
     ms = all_sum(state.s)
     mw = all_sum(state.w)
@@ -873,8 +1048,24 @@ def revive_rows(state, ids, cfg: RunConfig, num_nodes: int):
             converged=put(state.converged, False),
         )
     dt = np.dtype(state.s.dtype)
-    vals_np = (ids.astype(dt) / dt.type(num_nodes)
-               if cfg.value_mode == "scaled" else ids.astype(dt))
+    if state.s.ndim == 2:
+        if hasattr(state, "loss"):
+            # SGP: fresh-born nodes restart at the shared x₀ = 0 — the
+            # crashed-process analogue of the scalar init-value reset
+            vals_np = np.zeros((ids.size, state.s.shape[1]), dt)
+        else:
+            from gossipprotocol_tpu.protocols.state import (
+                pushsum_payload_values,
+            )
+
+            # same IEEE arithmetic as the device init: int index → dtype
+            # cast → divide by dtype(n), so revived rows are bitwise the
+            # init rows
+            vals_np = pushsum_payload_values(
+                ids, num_nodes, state.s.shape[1], cfg.value_mode, dt, np)
+    else:
+        vals_np = (ids.astype(dt) / dt.type(num_nodes)
+                   if cfg.value_mode == "scaled" else ids.astype(dt))
     vals = jnp.asarray(vals_np)
     streak0 = 1 if cfg.semantics == "reference" else 0
     return state._replace(

@@ -1,0 +1,16 @@
+from gossipprotocol_tpu.learn.data import (
+    SGPBundle,
+    make_least_squares,
+    lsq_node_loss,
+    lsq_node_grad,
+)
+from gossipprotocol_tpu.learn.sgp import make_sgp_core, sgp_init
+
+__all__ = [
+    "SGPBundle",
+    "make_least_squares",
+    "lsq_node_loss",
+    "lsq_node_grad",
+    "make_sgp_core",
+    "sgp_init",
+]

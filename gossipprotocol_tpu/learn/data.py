@@ -1,0 +1,86 @@
+"""Synthetic per-node data shards for the decentralized-learning workload.
+
+Stochastic Gradient Push trains one *global* model over data scattered
+across nodes: node ``i`` holds a private shard it alone computes
+gradients on, and push-sum averaging does the rest. The synthetic task
+here is least squares — node ``i`` draws ``m`` rows ``(Aᵢ, bᵢ)`` with
+``bᵢ = Aᵢ·θ* + noise`` against one shared ground truth ``θ*``, so
+
+    F(z) = (1/n) Σᵢ Fᵢ(z),   Fᵢ(z) = (1/2m) ‖Aᵢ z − bᵢ‖²
+
+is strongly convex with a known minimizer near ``θ*``, the per-node
+optima genuinely *disagree* (each shard alone is under-determined for
+``m < d``), and every quantity is seed-deterministic — the fixed-seed →
+identical-final-loss acceptance gate needs no tolerance.
+
+Everything is generated host-side with ``numpy.default_rng`` (counter
+PRNG, platform-stable) and shipped to the device once; the per-round
+gradient math in :mod:`learn.sgp` is pure row-local einsum, so the
+arrays shard over the node axis exactly like the neighbor tables.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# fold applied to the run seed so the data draw never collides with the
+# protocol's neighbor/loss key streams
+DATA_SEED_FOLD = 0xDA7A
+
+
+class SGPBundle(NamedTuple):
+    """What an SGP round needs per node, threaded through the engine's
+    ``nbrs`` slot (it is a pytree, so ``shard_map`` in_specs / device_put
+    shard the data rows exactly like the state rows).
+
+    ``nbrs`` is whatever neighbor structure the selected delivery wants
+    (CSR/dense tables for fanout-one, ``DiffusionEdges`` for fanout-all);
+    the SGP wrapper unwraps it before delegating to the mixing core.
+    """
+
+    nbrs: Any           # delivery neighbor pytree (or None: implicit full)
+    A: jax.Array        # float[rows, m, d]  per-node design matrix shard
+    b: jax.Array        # float[rows, m]     per-node targets
+
+
+def make_least_squares(
+    num_nodes: int,
+    payload_dim: int,
+    samples: int,
+    seed: int,
+    dtype=np.float32,
+    noise: float = 0.01,
+    rows: int | None = None,
+):
+    """Seed-deterministic shards: ``(A, b, theta_star)`` as numpy arrays.
+
+    ``rows`` pads the node axis (sharding): padding rows get zero data —
+    their gradients are identically zero, mirroring how phantom rows
+    carry no mass.
+    """
+    rng = np.random.default_rng(np.uint64(seed) ^ np.uint64(DATA_SEED_FOLD))
+    theta = rng.standard_normal(payload_dim)
+    a_full = rng.standard_normal((num_nodes, samples, payload_dim))
+    b_full = a_full @ theta + noise * rng.standard_normal((num_nodes, samples))
+    rows = num_nodes if rows is None else rows
+    a_out = np.zeros((rows, samples, payload_dim), dtype=dtype)
+    b_out = np.zeros((rows, samples), dtype=dtype)
+    a_out[:num_nodes] = a_full
+    b_out[:num_nodes] = b_full
+    return a_out, b_out, theta.astype(dtype)
+
+
+def lsq_node_loss(a: jax.Array, b: jax.Array, z: jax.Array) -> jax.Array:
+    """Per-node loss Fᵢ(zᵢ) = (1/2m) ‖Aᵢ zᵢ − bᵢ‖² → float[rows]."""
+    resid = jnp.einsum("nmd,nd->nm", a, z) - b
+    return 0.5 * jnp.mean(resid * resid, axis=1)
+
+
+def lsq_node_grad(a: jax.Array, b: jax.Array, z: jax.Array) -> jax.Array:
+    """Per-node gradient ∇Fᵢ(zᵢ) = (1/m) Aᵢᵀ(Aᵢ zᵢ − bᵢ) → float[rows, d]."""
+    resid = jnp.einsum("nmd,nd->nm", a, z) - b
+    return jnp.einsum("nmd,nm->nd", a, resid) / a.shape[1]

@@ -1,0 +1,105 @@
+"""Stochastic Gradient Push (arXiv:1811.10792) on the gossip engine.
+
+One SGP round is (i) a local gradient step and (ii) one push-sum mixing
+round, composed so the *existing* delivery machinery runs unchanged:
+
+    zₜ   = xₜ / wₜ                      (de-biased estimate — state.ratio)
+    z′   = zₜ − lr·∇Fᵢ(zₜ)  (× local_steps, full local batch)
+    xₜ₊½ = xₜ + (z′ − zₜ)              (gradient applied to the numerator)
+    (xₜ₊₁, wₜ₊₁) = push-sum mix of (xₜ₊½, wₜ)
+
+The de-bias-then-update form is the paper's: gradients are evaluated at
+the unbiased estimate ``z`` while the *biased* numerator ``x`` carries
+the update through the mass-weighted mixing. With ``local_steps = k``,
+``z′ − z = −lr · Σⱼ ∇Fᵢ(z⁽ʲ⁾)`` along the local trajectory.
+
+Convergence is consensus-distance AND loss-plateau: the mixing core's
+``global`` predicate certifies every node within ``tol`` of the current
+mass-weighted mean (consensus), and on top of that the mean train loss
+must have moved ≤ ``loss_tol`` since the previous round — consensus
+alone would fire while the optimizer is still descending.
+
+The wrapper is engine-agnostic: it has the same ``(state, nbrs, key,
+**kw)`` shape as every round core, with the :class:`~gossipprotocol_tpu.
+learn.data.SGPBundle` riding the ``nbrs`` slot, so both the single-chip
+chunk runner and the ``shard_map`` engine drive it unmodified.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from gossipprotocol_tpu.learn.data import lsq_node_grad, lsq_node_loss
+from gossipprotocol_tpu.protocols.pushsum import sum0
+from gossipprotocol_tpu.protocols.state import SGPState
+
+
+def sgp_init(
+    num_nodes: int,
+    payload_dim: int,
+    dtype=jnp.float32,
+    real_nodes: int | None = None,
+) -> SGPState:
+    """All nodes start at x₀ = 0, w₀ = 1 (phantom padding rows at 0, 0).
+
+    Zero init keeps the start deterministic and shared — SGP's consensus
+    term then only has to track the *gradient-induced* disagreement, and
+    the initial loss is the data variance ½·mean(b²).
+    """
+    n = real_nodes if real_nodes is not None else num_nodes
+    w = jnp.ones(num_nodes, dtype)
+    alive = jnp.ones(num_nodes, bool)
+    converged = jnp.zeros(num_nodes, bool)
+    if num_nodes > n:
+        phantom = jnp.arange(num_nodes) >= n
+        w = jnp.where(phantom, 0, w)
+        alive = alive & ~phantom
+        converged = converged | phantom
+    z = jnp.zeros((num_nodes, payload_dim), dtype)
+    return SGPState(
+        # distinct buffers: the chunk runner donates the whole state, and
+        # XLA rejects the same buffer donated twice
+        s=z,
+        w=w,
+        ratio=jnp.copy(z),
+        streak=jnp.zeros(num_nodes, jnp.int32),
+        converged=converged,
+        alive=alive,
+        round=jnp.int32(0),
+        # ∞ sentinel: the plateau test |Δloss| <= loss_tol can never fire
+        # on the first real round
+        loss=jnp.asarray(jnp.inf, jnp.float32),
+    )
+
+
+def make_sgp_core(mix_core, *, lr: float, local_steps: int,
+                  loss_tol: float, all_sum=sum0):
+    """Wrap a fully-bound push-sum mixing core into an SGP round core.
+
+    ``mix_core(state, nbrs, base_key, **kw)`` is any of the engine's
+    round cores (fanout-one scatter or fanout-all diffusion, single-chip
+    or shard_map-injected); the returned core has the identical calling
+    shape but expects an ``SGPBundle`` in the ``nbrs`` slot.
+    """
+
+    def core(state: SGPState, nbrs, base_key, **kw) -> SGPState:
+        bundle = nbrs  # SGPBundle riding the engine's nbrs slot
+        dt = state.s.dtype
+        step = jnp.asarray(lr, dt)
+        z0 = state.ratio
+        z = z0
+        for _ in range(local_steps):
+            z = z - step * lsq_node_grad(bundle.A, bundle.b, z)
+        live = state.alive[:, None]
+        x_half = state.s + jnp.where(live, z - z0, 0)
+        st = mix_core(state._replace(s=x_half), bundle.nbrs, base_key, **kw)
+        node_loss = lsq_node_loss(bundle.A, bundle.b, st.ratio)
+        alive_f = st.alive.astype(dt)
+        mean_loss = (
+            all_sum(jnp.where(st.alive, node_loss, 0))
+            / jnp.maximum(all_sum(alive_f), jnp.asarray(1, dt))
+        ).astype(jnp.float32)
+        plateau = jnp.abs(mean_loss - state.loss) <= loss_tol
+        return st._replace(converged=st.converged & plateau, loss=mean_loss)
+
+    return core

@@ -80,6 +80,37 @@ def make_counter_fn(
     if all_sum is None:
         all_sum = jnp.sum
 
+    if cfg.algorithm == "push-sum" and cfg.workload == "sgp":
+        # SGP rounds are a gradient step wrapped around a plain mixing
+        # round; the message traffic is exactly the mixing round's, with
+        # the delivery pytree riding inside the SGPBundle's nbrs slot —
+        # count through the inner branch after unwrapping
+        import dataclasses as _dc
+
+        inner = make_counter_fn(
+            topo, _dc.replace(cfg, workload="avg"),
+            all_alive=all_alive, targets_alive=targets_alive,
+            all_sum=all_sum, interpret=interpret, axis_name=axis_name,
+        )
+
+        def fn(old, new, bundle, base_key, alive_global, gids):
+            return inner(old, new, bundle.nbrs, base_key, alive_global,
+                         gids)
+
+        return fn
+
+    if cfg.algorithm == "push-sum" and cfg.accel != "off":
+        # the accelerated rounds apply the same one-W-pass diffusion
+        # delivery as plain scatter diffusion; the affine recombination
+        # moves no messages
+        import dataclasses as _dc
+
+        return make_counter_fn(
+            topo, _dc.replace(cfg, accel="off"),
+            all_alive=all_alive, targets_alive=targets_alive,
+            all_sum=all_sum, interpret=interpret, axis_name=axis_name,
+        )
+
     if cfg.algorithm == "gossip":
         from gossipprotocol_tpu.engine.driver import effective_keep_alive
         from gossipprotocol_tpu.protocols.gossip import gossip_message_counts
@@ -165,9 +196,20 @@ def ulp_drift(value, baseline) -> float:
     dtype), so ``np.spacing`` yields the correct unit in f32 and f64
     runs alike. Exact-conservation runs (dyadic push-sum arithmetic)
     report exactly 0.0; any rounding or genuine mass change is >= 1.
+
+    Vector payloads pass per-dimension [d] mass sums: drift is then
+    measured per dimension against that dimension's own baseline and the
+    *max* over dimensions is reported — one bad column must not be
+    averaged away by d−1 exact ones.
     """
     b = np.asarray(baseline)
-    v = float(np.float64(value))
+    v = np.asarray(value)
+    if b.ndim:
+        return max(
+            ulp_drift(v.reshape(-1)[k], b.reshape(-1)[k])
+            for k in range(b.size)
+        )
+    v = float(np.float64(v))
     bf = float(np.float64(b))
     if v == bf:
         return 0.0

@@ -204,7 +204,11 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
             f" dropped={counters.get('dropped', 0)}\n"
         )
         drift = manifest.get("max_mass_drift_ulps")
-        if drift is not None:
+        # SGP injects mass by design (the gradient step), so a conservation
+        # claim would be meaningless there — the driver never measures it
+        if drift is not None and (
+            manifest.get("config", {}).get("workload", "avg") != "sgp"
+        ):
             out.write(
                 f"push-sum mass drift: |Σs| ≤ {drift:g} ULPs,"
                 f" |Σw − n| ≤ {manifest.get('max_w_drift_ulps', 0.0):g} ULPs\n"
@@ -220,6 +224,19 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
         out.write(
             f"\nconvergence (fraction of alive nodes, rounds {first}..{last}):\n"
             f"  {sparkline(frac)}  {frac[-1] * 100:.1f}% final\n"
+        )
+
+    # train-loss sparkline (SGP runs record a "train_loss" per chunk) -----
+    losses = [
+        r["train_loss"] for r in metrics
+        if isinstance(r.get("train_loss"), (int, float))
+        and r["train_loss"] == r["train_loss"]  # drop NaN
+        and r["train_loss"] != float("inf")  # drop the pre-round ∞ sentinel
+    ]
+    if losses:
+        out.write(
+            f"\ntrain loss (mean over alive nodes):\n"
+            f"  {sparkline(losses)}  {losses[-1]:.3e} final\n"
         )
 
     # anomalies ----------------------------------------------------------

@@ -274,6 +274,33 @@ class RoutedDelivery(NamedTuple):  # registered below: geometry static
 _register_delivery()
 
 
+def matvec_payload(matvec, xs: jax.Array, xw: jax.Array):
+    """Route a vector payload through an unchanged two-stream matvec.
+
+    Every routed delivery (single-chip :class:`RoutedDelivery`, the
+    sharded pull and push variants in :mod:`ops.sharddelivery`) moves
+    exactly TWO f32 streams per call — the plans know nothing about what
+    the streams mean. An ``[rows, d]`` payload plus the scalar ``w``
+    stream is therefore ``d + 1`` streams routed pairwise through
+    ``ceil((d+1)/2)`` calls against the very same plans; an odd leftover
+    column pairs with zeros. ``xs`` 1-D is a single direct call — the
+    scalar path stays byte-identical.
+
+    Returns ``(in_s, in_w)`` with ``in_s`` shaped like ``xs``.
+    """
+    if xs.ndim == 1:
+        return matvec(xs, xw)
+    cols = [xs[:, k] for k in range(xs.shape[1])] + [xw]
+    outs = []
+    for i in range(0, len(cols) - 1, 2):
+        a, b = matvec(cols[i], cols[i + 1])
+        outs += [a, b]
+    if len(cols) % 2:
+        a, _ = matvec(cols[-1], jnp.zeros_like(cols[-1]))
+        outs.append(a)
+    return jnp.stack(outs[:-1], axis=1), outs[-1]
+
+
 def routed_streamed_bytes_per_round(rd: RoutedDelivery) -> int:
     """Edge-stream f32 bytes one matvec moves through the class layout:
     the interleaved ``[2 * m_pairs]`` slab (both expand output and

@@ -1188,30 +1188,37 @@ def pushsum_diffusion_round_routed_push(
     ``matvec(alive, alive)`` live-degree pass runs the identical
     exchange, so fault strikes stay exact under any device count.
     """
-    from gossipprotocol_tpu.protocols.pushsum import finish_pushsum_round
+    from gossipprotocol_tpu.ops.delivery import matvec_payload
+    from gossipprotocol_tpu.protocols.pushsum import (
+        finish_pushsum_round,
+        rowmask,
+    )
 
     del base_key  # deterministic: fanout-all draws nothing
     rd = jax.tree.map(lambda x: x[0], shard_rd)  # drop the shard axis
     dt = state.s.dtype
     deg = rd.degree.astype(dt)
     inv = 1 / (deg + 1)
-    share_s = state.s * inv
+    share_s = state.s * rowmask(inv, state.s)
     share_w = state.w * inv
     if not all_alive:
-        share_s = jnp.where(state.alive, share_s, 0)
+        share_s = jnp.where(rowmask(state.alive, share_s), share_s, 0)
         share_w = jnp.where(state.alive, share_w, 0)
-    in_s, in_w = rd.matvec(share_s, share_w, axis_name=axis_name,
-                           interpret=interpret)
+    in_s, in_w = matvec_payload(
+        lambda a, b: rd.matvec(a, b, axis_name=axis_name,
+                               interpret=interpret),
+        share_s, share_w,
+    )
     if all_alive or targets_alive:
-        sent_s = share_s * deg
+        sent_s = share_s * rowmask(deg, share_s)
         sent_w = share_w * deg
     else:
         alive_f = state.alive.astype(dt)
         live_deg, _ = rd.matvec(alive_f, alive_f, axis_name=axis_name,
                                 interpret=interpret)
-        in_s = jnp.where(state.alive, in_s, 0)
+        in_s = jnp.where(rowmask(state.alive, in_s), in_s, 0)
         in_w = jnp.where(state.alive, in_w, 0)
-        sent_s = share_s * live_deg
+        sent_s = share_s * rowmask(live_deg, share_s)
         sent_w = share_w * live_deg
     return finish_pushsum_round(
         state, state.s - sent_s + in_s, state.w - sent_w + in_w,
@@ -1310,31 +1317,36 @@ def pushsum_diffusion_round_routed_sharded(
     pushsum_diffusion_round_routed`, including the general-dead-set
     live-degree path (``targets_alive=False``).
     """
-    from gossipprotocol_tpu.protocols.pushsum import finish_pushsum_round
+    from gossipprotocol_tpu.ops.delivery import matvec_payload
+    from gossipprotocol_tpu.protocols.pushsum import (
+        finish_pushsum_round,
+        rowmask,
+    )
 
     del base_key  # deterministic: fanout-all draws nothing
     rd = jax.tree.map(lambda x: x[0], shard_rd)  # drop the shard axis
     dt = state.s.dtype
     deg = rd.degree.astype(dt)
     inv = 1 / (deg + 1)
-    share_s = state.s * inv
+    share_s = state.s * rowmask(inv, state.s)
     share_w = state.w * inv
     if not all_alive:
-        share_s = jnp.where(state.alive, share_s, 0)
+        share_s = jnp.where(rowmask(state.alive, share_s), share_s, 0)
         share_w = jnp.where(state.alive, share_w, 0)
     fs = jax.lax.all_gather(share_s, axis_name, tiled=True)
     fw = jax.lax.all_gather(share_w, axis_name, tiled=True)
-    in_s, in_w = rd.matvec(fs, fw, interpret=interpret)
+    in_s, in_w = matvec_payload(
+        lambda a, b: rd.matvec(a, b, interpret=interpret), fs, fw)
     if all_alive or targets_alive:
-        sent_s = share_s * deg
+        sent_s = share_s * rowmask(deg, share_s)
         sent_w = share_w * deg
     else:
         fa = jax.lax.all_gather(state.alive.astype(dt), axis_name,
                                 tiled=True)
         live_deg, _ = rd.matvec(fa, fa, interpret=interpret)
-        in_s = jnp.where(state.alive, in_s, 0)
+        in_s = jnp.where(rowmask(state.alive, in_s), in_s, 0)
         in_w = jnp.where(state.alive, in_w, 0)
-        sent_s = share_s * live_deg
+        sent_s = share_s * rowmask(live_deg, share_s)
         sent_w = share_w * live_deg
     return finish_pushsum_round(
         state, state.s - sent_s + in_s, state.w - sent_w + in_w,

@@ -102,7 +102,20 @@ def _sharded_core(
     # drop masks key on global ids, so the loss windows thread through the
     # sharded cores unchanged — same trajectories as single-chip
     loss_windows = cfg.schedule.static_loss_windows()
-    all_sum = lambda x: jax.lax.psum(jnp.sum(x), NODES_AXIS)  # noqa: E731
+    # node-axis reduction: scalar for 1-D operands (identical jaxpr to the
+    # pre-vector full sum), per-dimension [d] for vector payloads
+    all_sum = lambda x: jax.lax.psum(jnp.sum(x, axis=0), NODES_AXIS)  # noqa: E731
+
+    def wrap_workload(core):
+        if cfg.workload != "sgp":
+            return core
+        from gossipprotocol_tpu.learn import make_sgp_core
+
+        return make_sgp_core(
+            core, lr=cfg.lr, local_steps=cfg.local_steps,
+            loss_tol=cfg.loss_tol, all_sum=all_sum,
+        )
+
     if cfg.algorithm == "gossip":
         from gossipprotocol_tpu.engine.driver import gossip_inversion_enabled
 
@@ -115,6 +128,30 @@ def _sharded_core(
             inverted=gossip_inversion_enabled(topo, cfg),
             all_sum=all_sum,
             loss_windows=loss_windows,
+        )
+    if cfg.accel != "off":
+        from gossipprotocol_tpu.protocols.accel import (
+            accel_round_core,
+            estimate_gamma,
+        )
+
+        gamma = 0.0
+        if cfg.accel == "chebyshev":
+            gamma = (cfg.accel_lambda if cfg.accel_lambda is not None
+                     else estimate_gamma(topo))
+        return partial(
+            accel_round_core,
+            n=n,
+            variant=cfg.accel,
+            gamma=float(gamma),
+            eps=cfg.eps,
+            streak_target=cfg.streak_target,
+            predicate=cfg.predicate,
+            tol=cfg.tol,
+            all_sum=all_sum,
+            all_alive=all_alive,
+            targets_alive=targets_alive,
+            edge_chunks=cfg.edge_chunks,
         )
     if cfg.fanout == "all":
         if cfg.delivery == "routed":
@@ -148,7 +185,7 @@ def _sharded_core(
                 interpret=(platform != "tpu"),
                 axis_name=NODES_AXIS,
             )
-        return partial(
+        return wrap_workload(partial(
             pushsum_diffusion_round_core,
             n=n,
             eps=cfg.eps,
@@ -160,7 +197,7 @@ def _sharded_core(
             targets_alive=targets_alive,
             edge_chunks=cfg.edge_chunks,
             loss_windows=loss_windows,
-        )
+        ))
     if cfg.delivery == "invert":
         raise ValueError(
             "delivery='invert' is single-chip only: the value gather needs "
@@ -176,7 +213,7 @@ def _sharded_core(
             "process that cannot shard; run it single-chip (the "
             "reference is single-process anyway)"
         )
-    return partial(
+    return wrap_workload(partial(
         pushsum_round_core,
         n=n,
         eps=cfg.eps,
@@ -188,7 +225,7 @@ def _sharded_core(
         all_alive=all_alive,
         targets_alive=targets_alive,
         loss_windows=loss_windows,
-    )
+    ))
 
 
 def _state_specs(state):
@@ -207,10 +244,11 @@ def pad_state(state, n_padded: int):
     def pad(name, x):
         if jnp.ndim(x) == 0:
             return x
+        fill_shape = (extra,) + x.shape[1:]  # [n]-vectors and [n, d] payloads
         if name == "converged":
-            fill = jnp.ones(extra, x.dtype)
+            fill = jnp.ones(fill_shape, x.dtype)
         else:  # alive -> False; counts/s/w/ratio/streak -> 0
-            fill = jnp.zeros(extra, x.dtype)
+            fill = jnp.zeros(fill_shape, x.dtype)
         return jnp.concatenate([x, fill])
 
     return type(state)(*(pad(f, v) for f, v in zip(type(state)._fields, state)))
@@ -274,7 +312,7 @@ def make_sharded_chunk_runner(
     )
     is_pushsum = cfg.algorithm != "gossip"
     routed = is_pushsum and cfg.fanout == "all" and cfg.delivery == "routed"
-    psum_all = lambda x: jax.lax.psum(jnp.sum(x), NODES_AXIS)  # noqa: E731
+    psum_all = lambda x: jax.lax.psum(jnp.sum(x, axis=0), NODES_AXIS)  # noqa: E731
     counter_fn = None
     if tel.counters_on:
         from gossipprotocol_tpu.obs.counters import make_counter_fn
@@ -314,11 +352,19 @@ def make_sharded_chunk_runner(
             # results stack only for the single fused collective
             fa = jax.ops.segment_sum(a, t, num_segments=n_padded)
             fb = jax.ops.segment_sum(b, t, num_segments=n_padded)
+            if a.ndim == 1:
+                loc = jax.lax.psum_scatter(
+                    jnp.stack([fa, fb], axis=1), NODES_AXIS,
+                    scatter_dimension=0, tiled=True,
+                )
+                return loc[:, 0], loc[:, 1]
+            # vector payload: fa is [N, d] — ride the d payload columns and
+            # the weight column through the same single fused collective
             loc = jax.lax.psum_scatter(
-                jnp.stack([fa, fb], axis=1), NODES_AXIS,
+                jnp.concatenate([fa, fb[:, None]], axis=1), NODES_AXIS,
                 scatter_dimension=0, tiled=True,
             )
-            return loc[:, 0], loc[:, 1]
+            return loc[:, :-1], loc[:, -1]
 
         if routed:
             # the stacked shard-delivery leaves arrive as this device's
@@ -416,12 +462,16 @@ def make_sharded_chunk_runner(
         }
         if is_pushsum:
             big = jnp.asarray(jnp.inf, final.ratio.dtype)
+            live = (final.alive if final.ratio.ndim == 1
+                    else final.alive[:, None])
             stats["ratio_min"] = jax.lax.pmin(
-                jnp.min(jnp.where(final.alive, final.ratio, big)), NODES_AXIS
+                jnp.min(jnp.where(live, final.ratio, big)), NODES_AXIS
             )
             stats["ratio_max"] = jax.lax.pmax(
-                jnp.max(jnp.where(final.alive, final.ratio, -big)), NODES_AXIS
+                jnp.max(jnp.where(live, final.ratio, -big)), NODES_AXIS
             )
+            if hasattr(final, "loss"):
+                stats["train_loss"] = final.loss  # psum-replicated already
             # mirrors chunk_stats' dry-spell underflow detector
             stats["w_underflow"] = jax.lax.psum(
                 jnp.sum((final.alive & (final.w == 0)).astype(jnp.int32)),
@@ -469,9 +519,16 @@ def make_sharded_chunk_runner(
         nbrs = sharded_diffusion_edges(topo, n_padded, num_shards)
         nbrs_sharded = nbrs is not None  # None = implicit complete graph
     else:
+        import dataclasses as _dc
+
         from gossipprotocol_tpu.engine.driver import device_arrays
 
-        nbrs = pad_neighbors(device_arrays(topo, cfg), n_padded)
+        # SGP wraps the delivery pytree in a bundle; build the bare
+        # delivery here and wrap below, so padding/sharding of the
+        # neighbor tables stays on this one path
+        inner_cfg = (_dc.replace(cfg, workload="avg")
+                     if cfg.workload == "sgp" else cfg)
+        nbrs = pad_neighbors(device_arrays(topo, inner_cfg), n_padded)
         # dense adjacency rows align with the state rows -> shard over
         # "nodes" (each device holds only its own rows); CSR replicates
         # (its flat index pool can't split along node boundaries)
@@ -479,15 +536,42 @@ def make_sharded_chunk_runner(
     nbrs_specs = jax.tree.map(
         lambda _: P(NODES_AXIS) if nbrs_sharded else P(), nbrs
     )
+    sgp_bundle = is_pushsum and cfg.workload == "sgp"
+    if sgp_bundle:
+        from gossipprotocol_tpu.learn import SGPBundle, make_least_squares
+
+        a, b, _ = make_least_squares(
+            n, cfg.payload_dim, cfg.sgp_samples, cfg.seed,
+            dtype=np.dtype(jnp.dtype(cfg.dtype).name), rows=n_padded,
+        )
+        # data rows shard with the state rows; the inner delivery keeps
+        # its own placement (mixed specs within the bundle pytree)
+        if nbrs is not None:
+            nbrs = jax.device_put(
+                nbrs,
+                node_sharding(mesh) if nbrs_sharded else replicated(mesh),
+            )
+        data_sh = node_sharding(mesh)
+        nbrs = SGPBundle(
+            nbrs=nbrs,
+            A=jax.device_put(jnp.asarray(a), data_sh),
+            b=jax.device_put(jnp.asarray(b), data_sh),
+        )
+        nbrs_specs = SGPBundle(
+            nbrs=nbrs_specs, A=P(NODES_AXIS), b=P(NODES_AXIS))
 
     stats_fields = ["round", "done", "converged", "alive"]
     if cfg.algorithm != "gossip":
         stats_fields += ["ratio_min", "ratio_max", "w_underflow"]
+        if cfg.workload == "sgp":
+            stats_fields += ["train_loss"]
     else:
         stats_fields += ["spreading"]
     if counter_fn is not None:
         stats_fields += ["counters"]
-        if is_pushsum:
+        if is_pushsum and cfg.workload != "sgp":
+            # SGP injects mass every round by design; mass_stats returns
+            # nothing for it (see engine.driver.mass_stats)
             stats_fields += ["mass_s", "mass_w"]
     stats_specs = {k: P() for k in stats_fields}
     sm = shard_map(
@@ -501,7 +585,7 @@ def make_sharded_chunk_runner(
 
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
     state0 = jax.device_put(state0, shardings)
-    if nbrs is not None:
+    if nbrs is not None and not sgp_bundle:  # bundle placed piecewise above
         nbrs = jax.device_put(
             nbrs, node_sharding(mesh) if nbrs_sharded else replicated(mesh)
         )

@@ -43,7 +43,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gossipprotocol_tpu.protocols.pushsum import finish_pushsum_round
+from gossipprotocol_tpu.protocols.pushsum import (
+    finish_pushsum_round,
+    rowmask,
+    sum0,
+)
 from gossipprotocol_tpu.protocols.state import PushSumState
 from gossipprotocol_tpu.topology.base import Topology
 
@@ -122,43 +126,31 @@ def sharded_diffusion_edges(
     )
 
 
-def pushsum_diffusion_round_core(
-    state: PushSumState,
+def diffusion_mix(
+    state,
     nbrs: Optional[DiffusionEdges],
     base_key: jax.Array,
     *,
     n: int,
     scatter,
     alive_global,
-    eps: float = 1e-10,
-    streak_target: int = 3,
-    predicate: str = "delta",
-    tol: float = 1e-4,
-    all_sum=jnp.sum,
+    all_sum=sum0,
     all_alive: bool = False,
     targets_alive: bool = False,
     edge_chunks: int = 1,
     loss_windows: tuple = (),
     row_offset=0,
-) -> PushSumState:
-    """One synchronous fanout-all round.
+):
+    """The lazy-random-walk mixing step alone: returns
+    ``(s_new, w_new, in_w)`` with no predicate applied.
 
-    ``scatter(a_e, b_e, dst_e) -> (in_a, in_b)`` is injected like the
-    single-target round's: a plain ``segment_sum`` single-chip, partial
-    ``segment_sum`` + ``psum_scatter`` under ``shard_map``. The liveness
-    fast-path flags carry the exact same legality contract as
-    :func:`pushsum_round_core` (``all_alive``: nobody can die;
-    ``targets_alive``: the dead set is component-closed, so an alive
-    node's neighbors are alive and no per-edge target-liveness gather is
-    needed — dead→dead edges ship a zero share and deliver nothing).
-
-    ``loss_windows`` adds a per-directed-edge Bernoulli drop mask keyed on
-    the **global** (src, dst) pair — ``row_offset`` globalizes the local
-    ``src`` indices under ``shard_map`` — so the mask is sharding-
-    invariant. A dropped edge's share stays with the sender via the same
-    delivered-count accounting the dead-target path uses.
+    Extracted from the full round so the accelerated variants
+    (:mod:`protocols.accel`) can apply ``W x_t`` and then affine-combine
+    with the previous iterate before running the shared predicate tail.
+    Payload-polymorphic: ``state.s`` may be ``[rows]`` or ``[rows, d]``
+    (``w`` always per-node); the d=1 trace is the pre-vector program.
     """
-    dt = state.s.dtype
+    dt = state.w.dtype
     if loss_windows:
         from gossipprotocol_tpu.protocols.sampling import (
             LOSS_FOLD, drop_mask, loss_probability,
@@ -184,7 +176,7 @@ def pushsum_diffusion_round_core(
             a_count = jnp.maximum(
                 all_sum(state.alive.astype(dt)), jnp.asarray(1, dt)
             )
-            s_m = jnp.where(state.alive, state.s, 0)
+            s_m = jnp.where(rowmask(state.alive, state.s), state.s, 0)
             w_m = jnp.where(state.alive, state.w, 0)
         share_s = s_m / a_count
         share_w = w_m / a_count
@@ -193,22 +185,17 @@ def pushsum_diffusion_round_core(
         sent_s = share_s * (a_count - 1)
         sent_w = share_w * (a_count - 1)
         if not all_alive:
-            in_s = jnp.where(state.alive, in_s, 0)
+            in_s = jnp.where(rowmask(state.alive, in_s), in_s, 0)
             in_w = jnp.where(state.alive, in_w, 0)
-        return finish_pushsum_round(
-            state, state.s - sent_s + in_s, state.w - sent_w + in_w,
-            received=in_w > 0, eps=eps, streak_target=streak_target,
-            reference_semantics=False, predicate=predicate, tol=tol,
-            all_sum=all_sum, all_alive=all_alive,
-        )
+        return state.s - sent_s + in_s, state.w - sent_w + in_w, in_w
 
-    rows = state.s.shape[0]
+    rows = state.w.shape[0]
     deg = nbrs.degree.astype(dt)
     inv = 1 / (deg + 1)
-    share_s = state.s * inv
+    share_s = state.s * rowmask(inv, state.s)
     share_w = state.w * inv
     if not all_alive:
-        share_s = jnp.where(state.alive, share_s, 0)
+        share_s = jnp.where(rowmask(state.alive, share_s), share_s, 0)
         share_w = jnp.where(state.alive, share_w, 0)
 
     # Delivery, optionally in ``edge_chunks`` sequential slices: the
@@ -221,7 +208,7 @@ def pushsum_diffusion_round_core(
     zero = jnp.asarray(0, dt)
     e_total = nbrs.src.shape[0]
     bounds = [e_total * k // edge_chunks for k in range(edge_chunks + 1)]
-    in_s = jnp.zeros(rows, dt)
+    in_s = jnp.zeros(share_s.shape, dt)
     in_w = jnp.zeros(rows, dt)
     fast_alive = all_alive or targets_alive
     # the delivered-count makes ``sent = share · cnt`` exact whenever any
@@ -261,19 +248,73 @@ def pushsum_diffusion_round_core(
             d_s, d_w = scatter(es, ew, dst_k)
         else:
             d_s, d_w = scatter(
-                jnp.where(deliver, es, zero), jnp.where(deliver, ew, zero),
+                jnp.where(rowmask(deliver, es), es, zero),
+                jnp.where(deliver, ew, zero),
                 dst_k,
             )
         in_s = in_s + d_s
         in_w = in_w + d_w
     if needs_cnt:
-        sent_s = share_s * cnt
+        sent_s = share_s * rowmask(cnt, share_s)
         sent_w = share_w * cnt
     else:
-        sent_s = share_s * deg
+        sent_s = share_s * rowmask(deg, share_s)
         sent_w = share_w * deg
+    return state.s - sent_s + in_s, state.w - sent_w + in_w, in_w
+
+
+def pushsum_diffusion_round_core(
+    state: PushSumState,
+    nbrs: Optional[DiffusionEdges],
+    base_key: jax.Array,
+    *,
+    n: int,
+    scatter,
+    alive_global,
+    eps: float = 1e-10,
+    streak_target: int = 3,
+    predicate: str = "delta",
+    tol: float = 1e-4,
+    all_sum=sum0,
+    all_alive: bool = False,
+    targets_alive: bool = False,
+    edge_chunks: int = 1,
+    loss_windows: tuple = (),
+    row_offset=0,
+) -> PushSumState:
+    """One synchronous fanout-all round.
+
+    ``scatter(a_e, b_e, dst_e) -> (in_a, in_b)`` is injected like the
+    single-target round's: a plain ``segment_sum`` single-chip, partial
+    ``segment_sum`` + ``psum_scatter`` under ``shard_map``. The liveness
+    fast-path flags carry the exact same legality contract as
+    :func:`pushsum_round_core` (``all_alive``: nobody can die;
+    ``targets_alive``: the dead set is component-closed, so an alive
+    node's neighbors are alive and no per-edge target-liveness gather is
+    needed — dead→dead edges ship a zero share and deliver nothing).
+
+    ``loss_windows`` adds a per-directed-edge Bernoulli drop mask keyed on
+    the **global** (src, dst) pair — ``row_offset`` globalizes the local
+    ``src`` indices under ``shard_map`` — so the mask is sharding-
+    invariant. A dropped edge's share stays with the sender via the same
+    delivered-count accounting the dead-target path uses.
+    """
+    s_new, w_new, in_w = diffusion_mix(
+        state,
+        nbrs,
+        base_key,
+        n=n,
+        scatter=scatter,
+        alive_global=alive_global,
+        all_sum=all_sum,
+        all_alive=all_alive,
+        targets_alive=targets_alive,
+        edge_chunks=edge_chunks,
+        loss_windows=loss_windows,
+        row_offset=row_offset,
+    )
     return finish_pushsum_round(
-        state, state.s - sent_s + in_s, state.w - sent_w + in_w,
+        state, s_new, w_new,
         received=in_w > 0, eps=eps, streak_target=streak_target,
         reference_semantics=False, predicate=predicate, tol=tol,
         all_sum=all_sum, all_alive=all_alive,
@@ -445,36 +486,41 @@ def pushsum_diffusion_round_routed(
     same values the scatter path's delivered-count accounting produces,
     at ~1.5× the per-round cost while a fault plan is in force.
     """
+    from gossipprotocol_tpu.ops.delivery import matvec_payload
+
     del base_key  # deterministic: fanout-all draws nothing
-    dt = state.s.dtype
-    rows = state.s.shape[0]
+    dt = state.w.dtype
+    rows = state.w.shape[0]
     deg = routed.degree.astype(dt)
     if rows > n:
         deg = jnp.pad(deg, (0, rows - n))
     inv = 1 / (deg + 1)
-    share_s = state.s * inv
+    share_s = state.s * rowmask(inv, state.s)
     share_w = state.w * inv
     if not all_alive:
-        share_s = jnp.where(state.alive, share_s, 0)
+        share_s = jnp.where(rowmask(state.alive, share_s), share_s, 0)
         share_w = jnp.where(state.alive, share_w, 0)
-    in_s, in_w = routed.matvec(share_s, share_w, interpret=interpret)
+    in_s, in_w = matvec_payload(
+        lambda a, b: routed.matvec(a, b, interpret=interpret),
+        share_s, share_w,
+    )
     if all_alive or targets_alive:
-        sent_s = share_s * deg
+        sent_s = share_s * rowmask(deg, share_s)
         sent_w = share_w * deg
     else:
         alive_f = state.alive.astype(dt)
         live_deg, _ = routed.matvec(alive_f, alive_f, interpret=interpret)
         # a dead receiver's in-sum is garbage only to itself: discard it
         # (the sender already kept that share via live_deg below)
-        in_s = jnp.where(state.alive, in_s, 0)
+        in_s = jnp.where(rowmask(state.alive, in_s), in_s, 0)
         in_w = jnp.where(state.alive, in_w, 0)
-        sent_s = share_s * live_deg
+        sent_s = share_s * rowmask(live_deg, share_s)
         sent_w = share_w * live_deg
     return finish_pushsum_round(
         state, state.s - sent_s + in_s, state.w - sent_w + in_w,
         received=in_w > 0, eps=eps, streak_target=streak_target,
         reference_semantics=False, predicate=predicate, tol=tol,
-        all_sum=jnp.sum, all_alive=all_alive,
+        all_sum=sum0, all_alive=all_alive,
     )
 
 

@@ -49,6 +49,21 @@ from gossipprotocol_tpu.protocols.state import PushSumState
 from gossipprotocol_tpu.topology.base import Topology
 
 
+def sum0(x: jax.Array) -> jax.Array:
+    """Sum over the node axis only: scalar for ``[n]`` state (identical
+    program to ``jnp.sum``), per-dimension ``[d]`` for ``[n, d]`` payloads.
+    The default ``all_sum`` everywhere, so global means / mass totals are
+    per-dimension under vector payloads without touching the d=1 jaxpr."""
+    return jnp.sum(x, axis=0)
+
+
+def rowmask(mask: jax.Array, like: jax.Array) -> jax.Array:
+    """Broadcast a per-node ``[rows]`` mask against ``like`` (``[rows]``
+    or ``[rows, d]``). The d=1 branch returns ``mask`` itself, keeping the
+    scalar-path expressions literally unchanged."""
+    return mask if like.ndim == 1 else mask[:, None]
+
+
 def pushsum_round_core(
     state: PushSumState,
     nbrs,  # CSRNeighbors | DenseNeighbors | None (implicit full graph)
@@ -63,7 +78,7 @@ def pushsum_round_core(
     reference_semantics: bool = False,
     predicate: str = "delta",
     tol: float = 1e-4,
-    all_sum=jnp.sum,
+    all_sum=sum0,
     all_alive: bool = False,
     targets_alive: bool = False,
     delivery: str = "scatter",
@@ -115,6 +130,7 @@ def pushsum_round_core(
         # send must return mass to the sender, which the gather can't).
         assert gids is None, "delivery='invert' is single-chip only"
         assert not loss_windows, "delivery='invert' cannot model loss"
+        assert state.s.ndim == 1, "delivery='invert' is scalar-payload only"
         valid = nbrs.degree > 0
         deliver = valid if all_alive else (valid & state.alive)
         s_sent = jnp.where(deliver, state.s * 0.5, jnp.zeros_like(state.s))
@@ -148,7 +164,8 @@ def pushsum_round_core(
                 jax.random.fold_in(key, LOSS_FOLD), p, gid_rows
             )
             deliver = deliver & ~drop
-        s_sent = jnp.where(deliver, state.s * 0.5, jnp.zeros_like(state.s))
+        s_sent = jnp.where(
+            rowmask(deliver, state.s), state.s * 0.5, jnp.zeros_like(state.s))
         w_sent = jnp.where(deliver, state.w * 0.5, jnp.zeros_like(state.w))
 
         in_s, in_w = scatter(s_sent, w_sent, targets)
@@ -233,6 +250,12 @@ def finish_pushsum_round(
     Used by both senders — the single-target random-walk round above and
     the fanout-all diffusion round (:mod:`protocols.diffusion`) — so the
     predicate semantics cannot drift between the two.
+
+    Payload-polymorphic: ``s_new`` may be ``[n]`` or ``[n, d]`` (``w`` is
+    always per-node). Under vector payloads the per-node predicate
+    requires *every* dimension within tolerance, and the new state is
+    built with ``state._replace`` so richer state types (SGP, accel) flow
+    through with their extra fields intact.
     """
     # The maximum guards dead/isolated rows AND alive nodes in deep
     # receipt dry spells: (s, w) halve every send-only round, so a
@@ -240,7 +263,8 @@ def finish_pushsum_round(
     # 0 (the measured 100M-scale wall — README "Convergence-predicate
     # soundness"; chunk stats count these as w_underflow). Removing the
     # guard would turn those rows into 0/0 NaNs.
-    ratio_new = s_new / jnp.maximum(w_new, jnp.asarray(1e-30, w_new.dtype))
+    w_floor = jnp.maximum(w_new, jnp.asarray(1e-30, w_new.dtype))
+    ratio_new = s_new / (w_floor if s_new.ndim == 1 else w_floor[:, None])
 
     if reference_semantics:
         # Program.fs:109-114: delta is computed after the commit and is
@@ -248,16 +272,22 @@ def finish_pushsum_round(
         # message (here: every round with incoming mass).
         streak = jnp.where(received, state.streak + 1, state.streak)
     elif predicate == "global":
-        s_healthy = s_new if all_alive else jnp.where(state.alive, s_new, 0)
+        s_healthy = s_new if all_alive else jnp.where(
+            rowmask(state.alive, s_new), s_new, 0)
         w_healthy = w_new if all_alive else jnp.where(state.alive, w_new, 0)
         mean = all_sum(s_healthy) / jnp.maximum(
             all_sum(w_healthy), jnp.asarray(1e-30, w_new.dtype)
         )
         near = jnp.abs(ratio_new - mean) <= tol
+        if near.ndim == 2:
+            near = jnp.all(near, axis=-1)
         streak = jnp.where(near, state.streak + 1, 0)
     else:
         delta = jnp.abs(ratio_new - state.ratio)
-        streak = jnp.where(delta <= eps, state.streak + 1, 0)
+        near = delta <= eps
+        if near.ndim == 2:
+            near = jnp.all(near, axis=-1)
+        streak = jnp.where(near, state.streak + 1, 0)
 
     if predicate == "global" and not reference_semantics:
         # non-sticky: a node that drifts back out of tol (transient
@@ -268,13 +298,12 @@ def finish_pushsum_round(
     else:
         # sticky, like the reference's one-shot Alert (Program.fs:94)
         converged = state.converged | (streak >= streak_target)
-    return PushSumState(
+    return state._replace(
         s=s_new,
         w=w_new,
         ratio=ratio_new,
         streak=streak,
         converged=converged,
-        alive=state.alive,
         round=state.round + 1,
     )
 

@@ -34,15 +34,57 @@ class PushSumState(NamedTuple):
     ``Program.fs:67-69``). ``ratio`` caches s/w from the previous round so
     the convergence delta is computed *against the pre-update estimate* —
     the reference's intended predicate, minus its commit-before-compare bug
-    (``Program.fs:109-114``, SURVEY.md §2.4.2)."""
+    (``Program.fs:109-114``, SURVEY.md §2.4.2).
 
-    s: jax.Array           # float[N]  running sum component
+    With ``payload_dim > 1`` (vector payloads) ``s`` and ``ratio`` are
+    ``[N, d]``; ``w`` stays ``[N]`` — one weight per node scales every
+    payload dimension, exactly as in Stochastic Gradient Push
+    (arXiv:1811.10792). ``payload_dim == 1`` keeps the scalar ``[N]``
+    shapes, so the d=1 program is bitwise the pre-vector one."""
+
+    s: jax.Array           # float[N] or float[N, d]  running sum component
     w: jax.Array           # float[N]  running weight component
-    ratio: jax.Array       # float[N]  previous-round s/w estimate
+    ratio: jax.Array       # float[N] or float[N, d]  previous s/w estimate
     streak: jax.Array      # int32[N]  consecutive rounds with |Δratio| <= eps
     converged: jax.Array   # bool[N]
     alive: jax.Array       # bool[N]
     round: jax.Array       # int32 scalar
+
+
+class SGPState(NamedTuple):
+    """Stochastic-Gradient-Push state: push-sum fields plus the mean
+    train loss of the de-biased estimates, carried so the convergence
+    predicate can demand a loss plateau on top of consensus distance.
+    Field order matches :class:`PushSumState` so the generic round cores
+    (which use ``state._replace``) and the checkpoint/pad/spec machinery
+    work unchanged."""
+
+    s: jax.Array           # float[N, d]  biased parameter numerator x
+    w: jax.Array           # float[N]  push-sum weight
+    ratio: jax.Array       # float[N, d]  de-biased estimate z = x / w
+    streak: jax.Array      # int32[N]
+    converged: jax.Array   # bool[N]
+    alive: jax.Array       # bool[N]
+    round: jax.Array       # int32 scalar
+    loss: jax.Array        # float32 scalar  mean train loss over alive nodes
+
+
+class AccelState(NamedTuple):
+    """Two-buffer accelerated push-sum state (Chebyshev semi-iterative /
+    EPD, arXiv:2202.10742). ``s_prev``/``w_prev`` hold the previous
+    iterate for the affine combination x_{t+1} = a_t·W x_t + (1−a_t)·x_{t−1};
+    ``omega`` carries the Chebyshev weight recurrence (unused by EPD)."""
+
+    s: jax.Array           # float[N] or float[N, d]
+    w: jax.Array           # float[N]
+    ratio: jax.Array       # float[N] or float[N, d]
+    streak: jax.Array      # int32[N]
+    converged: jax.Array   # bool[N]
+    alive: jax.Array       # bool[N]
+    round: jax.Array       # int32 scalar
+    s_prev: jax.Array      # float[N] or float[N, d]  x_{t-1}
+    w_prev: jax.Array      # float[N]  w_{t-1}
+    omega: jax.Array       # float scalar  Chebyshev ω_t (0 before round 1)
 
 
 def gossip_init(num_nodes: int, seed_node: int, dtype=jnp.int32) -> GossipState:
@@ -63,12 +105,31 @@ def gossip_init(num_nodes: int, seed_node: int, dtype=jnp.int32) -> GossipState:
     )
 
 
+def pushsum_payload_values(ids, num_nodes: int, payload_dim: int,
+                           value_mode: str, dtype, np_mod):
+    """Vector-payload initial values for the given node ids: column ``k``
+    holds the scalar init of node ``(i + k) mod N`` — each dimension is a
+    rotation of the scalar profile, so every dimension has the same known
+    mean but a distinct per-node signal. Shared by device init and
+    host-side revive so a revived row is bitwise a fresh-born one.
+
+    ``np_mod`` is ``jax.numpy`` (device init) or ``numpy`` (revive); the
+    integer→float cast then divide is IEEE-identical in both.
+    """
+    idx = (ids[:, None] + np_mod.arange(payload_dim)[None, :]) % num_nodes
+    vals = idx.astype(dtype)
+    if value_mode == "index":
+        return vals
+    return vals / np_mod.asarray(num_nodes, dtype)
+
+
 def pushsum_init(
     num_nodes: int,
     value_mode: str = "scaled",
     dtype=jnp.float32,
     reference_semantics: bool = False,
     real_nodes: int | None = None,
+    payload_dim: int = 1,
 ) -> PushSumState:
     """Initial push-sum state.
 
@@ -95,19 +156,31 @@ def pushsum_init(
     2nd received message.
     """
     n = real_nodes if real_nodes is not None else num_nodes
-    i = jnp.arange(num_nodes, dtype=dtype)
-    s = i / n if value_mode == "scaled" else i
-    w = jnp.ones(num_nodes, dtype)
-    if num_nodes > n:
-        phantom = jnp.arange(num_nodes) >= n
-        s = jnp.where(phantom, 0, s)
-        w = jnp.where(phantom, 0, w)
+    if payload_dim == 1:
+        # scalar path: byte-for-byte the pre-vector program
+        i = jnp.arange(num_nodes, dtype=dtype)
+        s = i / n if value_mode == "scaled" else i
+        w = jnp.ones(num_nodes, dtype)
+        if num_nodes > n:
+            phantom = jnp.arange(num_nodes) >= n
+            s = jnp.where(phantom, 0, s)
+            w = jnp.where(phantom, 0, w)
+        # maximum guards the zero-weight phantom rows (0/0 -> NaN)
+        ratio = s / jnp.maximum(w, jnp.asarray(1e-30, dtype))
+    else:
+        s = pushsum_payload_values(
+            jnp.arange(num_nodes), n, payload_dim, value_mode, dtype, jnp)
+        w = jnp.ones(num_nodes, dtype)
+        if num_nodes > n:
+            phantom = jnp.arange(num_nodes) >= n
+            s = jnp.where(phantom[:, None], 0, s)
+            w = jnp.where(phantom, 0, w)
+        ratio = s / jnp.maximum(w, jnp.asarray(1e-30, dtype))[:, None]
     streak0 = 1 if reference_semantics else 0
     return PushSumState(
         s=s,
         w=w,
-        # maximum guards the zero-weight phantom rows (0/0 -> NaN)
-        ratio=s / jnp.maximum(w, jnp.asarray(1e-30, dtype)),
+        ratio=ratio,
         streak=jnp.full(num_nodes, streak0, jnp.int32),
         converged=jnp.zeros(num_nodes, bool),
         alive=jnp.ones(num_nodes, bool),

@@ -18,11 +18,17 @@ from typing import Tuple
 import jax
 import numpy as np
 
-from gossipprotocol_tpu.protocols.state import GossipState, PushSumState
+from gossipprotocol_tpu.protocols.state import (
+    AccelState,
+    GossipState,
+    PushSumState,
+    SGPState,
+)
 from gossipprotocol_tpu.protocols.walk import WalkState
 
 _STATE_TYPES = {"GossipState": GossipState, "PushSumState": PushSumState,
-                "WalkState": WalkState}
+                "WalkState": WalkState, "SGPState": SGPState,
+                "AccelState": AccelState}
 
 # Every RunConfig field that influences the trajectory. Saved in checkpoint
 # metadata and compared generically on resume — resuming under a different
@@ -52,6 +58,12 @@ TRAJECTORY_FIELDS = (
     # under prune (or off) would replay different topologies from the same
     # checkpoint — refused, like any other trajectory-field mismatch
     "repair",
+    # the decentralized-learning knobs: payload width changes every state
+    # shape, the workload swaps the round function entirely, and the SGP /
+    # acceleration hyperparameters steer each round's arithmetic — a
+    # resume under any other value continues a different trajectory
+    "payload_dim", "workload", "accel", "accel_lambda", "lr",
+    "local_steps", "sgp_samples", "loss_tol",
 )
 
 
@@ -65,7 +77,13 @@ TRAJECTORY_FIELDS = (
 LEGACY_FIELD_DEFAULTS = {"fanout": "one", "delivery": "scatter",
                          # pre-repair checkpoints necessarily ran with the
                          # only behavior that existed: no repair
-                         "repair": "off"}
+                         "repair": "off",
+                         # pre-learn checkpoints are the scalar averaging
+                         # protocol: one payload column, no workload, no
+                         # acceleration (the SGP/accel hyperparameters are
+                         # moot under those and wildcard like eps/tol)
+                         "payload_dim": 1, "workload": "avg",
+                         "accel": "off"}
 
 # Sentinel written for alert_quorum=None (the all-nodes stop rule). None
 # cannot be stored raw: resume validation could not tell "all-nodes run"

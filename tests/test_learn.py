@@ -1,0 +1,510 @@
+"""Decentralized-learning subsystem tests (ISSUE PR 6).
+
+Covers the four layers the subsystem adds:
+
+  * vector payloads: ``[n, d]`` state riding the existing delivery plans —
+    per-dimension mass conservation, per-dimension convergence, routed ==
+    scatter, sharded == single-chip;
+  * the d=1 bitwise guard: with ``payload_dim=1`` (the default) every
+    push-sum path must produce the *exact pre-PR scalar bits* — pinned as
+    sha256 digests recorded from the pre-PR tree (commit cbbe16e) on the
+    CPU backend, single-chip and 2/4/8-shard;
+  * Stochastic Gradient Push: deterministic convergence on the synthetic
+    least-squares shards (fixed seed ⇒ identical final loss);
+  * accelerated gossip: Chebyshev/EPD conserve mass to dtype rounding and
+    Chebyshev beats plain push-sum by ≥2× rounds on the line graph (the
+    slow acceptance run writes artifacts/accel_line1000.json).
+"""
+
+import hashlib
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "artifacts", "accel_line1000.json")
+
+
+def state_digest(state):
+    """sha256 over the protocol payload bits — the bitwise-guard witness."""
+    h = hashlib.sha256()
+    for f in ("s", "w", "ratio"):
+        h.update(np.ascontiguousarray(np.asarray(getattr(state, f))).tobytes())
+    return h.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: d=1 bitwise guard
+# ---------------------------------------------------------------------------
+
+# Digests of the final (s, w, ratio) bits recorded from the pre-PR tree
+# (commit cbbe16e, CPU backend, x64 disabled). payload_dim=1 must keep
+# producing exactly these bits: the vector generalization branches at
+# trace time (rowmask/sum0 in protocols/pushsum.py), so d=1 traces the
+# literal pre-PR scalar jaxpr.
+_SCALAR_GOLDENS = {
+    "scatter_one_imp3D64": ("b28e3852b49c73df", 161),
+    "diffusion_all_line32": ("4a7d2d7205b47efe", 400),
+    "diffusion_all_full64": ("7e561b36eabe274a", 3),
+    "routed_er64": ("1303c2fc6814c146", 300),
+}
+
+_SCALAR_SCENARIOS = {
+    "scatter_one_imp3D64": (
+        ("imp3D", 64), dict(algorithm="push-sum", seed=7, max_rounds=300)),
+    "diffusion_all_line32": (
+        ("line", 32),
+        dict(algorithm="push-sum", seed=3, fanout="all", max_rounds=400)),
+    "diffusion_all_full64": (
+        ("full", 64),
+        dict(algorithm="push-sum", seed=5, fanout="all", predicate="global",
+             tol=1e-6, max_rounds=200)),
+    "routed_er64": (
+        ("erdos_renyi", 64),
+        dict(algorithm="push-sum", seed=9, fanout="all", delivery="routed",
+             max_rounds=300)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_SCALAR_SCENARIOS))
+def test_d1_bitwise_matches_pre_vector_scalar_path(name):
+    if jax.config.jax_enable_x64 or jax.default_backend() != "cpu":
+        pytest.skip("goldens recorded on CPU backend, x64 off")
+    (kind, n), cfg_kw = _SCALAR_SCENARIOS[name]
+    topo = build_topology(kind, n, seed=1)
+    res = run_simulation(topo, RunConfig(**cfg_kw))
+    digest, rounds = _SCALAR_GOLDENS[name]
+    assert res.rounds == rounds
+    assert state_digest(res.final_state) == digest, (
+        "payload_dim=1 produced different bits than the pre-vector scalar "
+        "path — the d=1 trace-time branch no longer reproduces the old jaxpr"
+    )
+
+
+@pytest.mark.parametrize("shards", [2, 4, 8])
+def test_d1_bitwise_sharded(shards, cpu_devices):
+    """Same guard across 2/4/8 shards (goldens per shard count: the
+    scatter sums reorder across shard boundaries, so each mesh size has
+    its own — pre-PR-recorded — bits)."""
+    if jax.config.jax_enable_x64 or jax.default_backend() != "cpu":
+        pytest.skip("goldens recorded on CPU backend, x64 off")
+    goldens = {
+        2: ("386a79b2cda98efa", 111, "1de0f365d3c54925", 300),
+        4: ("a9473458068753b6", 111, "1de0f365d3c54925", 300),
+        8: ("3ad0dd10fd198a61", 116, "1de0f365d3c54925", 300),
+    }[shards]
+    mesh = make_mesh(devices=cpu_devices[:shards])
+    topo = build_topology("erdos_renyi", 96, avg_degree=8.0, seed=3)
+    cfg = RunConfig(algorithm="push-sum", seed=7, chunk_rounds=64,
+                    max_rounds=200)
+    res = run_simulation_sharded(topo, cfg, mesh=mesh)
+    assert (state_digest(res.final_state), res.rounds) == goldens[:2]
+    topo = build_topology("line", 64, seed=1)
+    cfg = RunConfig(algorithm="push-sum", seed=2, fanout="all",
+                    chunk_rounds=64, max_rounds=300)
+    res = run_simulation_sharded(topo, cfg, mesh=mesh)
+    assert (state_digest(res.final_state), res.rounds) == goldens[2:]
+
+
+# ---------------------------------------------------------------------------
+# vector payloads
+# ---------------------------------------------------------------------------
+
+def test_vector_mass_conserved_per_dimension():
+    """Each payload column is an independent conserved quantity."""
+    topo = build_topology("imp3D", 64, seed=1)
+    cfg = RunConfig(algorithm="push-sum", seed=7, payload_dim=5,
+                    max_rounds=50)
+    res = run_simulation(topo, cfg)
+    s = np.asarray(res.final_state.s, np.float64)
+    assert s.shape == (64, 5)
+    # scaled value mode, column k: sum_i ((i+k) % n) / n == (n-1)/2
+    np.testing.assert_allclose(s.sum(axis=0), np.full(5, (64 - 1) / 2.0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(res.final_state.w, np.float64).sum(), 64, rtol=1e-5)
+
+
+def test_vector_converges_to_per_dim_mean():
+    topo = build_topology("imp3D", 64, seed=1)
+    cfg = RunConfig(algorithm="push-sum", seed=7, payload_dim=4,
+                    predicate="global", tol=1e-4, fanout="all",
+                    max_rounds=500)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    ratio = np.asarray(res.final_state.ratio)
+    # every column's mean is (n-1)/(2n) in scaled mode (cyclic shift)
+    np.testing.assert_allclose(ratio, (64 - 1) / (2.0 * 64), atol=5e-4)
+
+
+def test_vector_routed_matches_scatter():
+    """d>1 payloads through the routed matvec plans == the scatter path
+    (same delivery semantics, different float accumulation order)."""
+    topo = build_topology("erdos_renyi", 300, seed=2)
+    base = dict(algorithm="push-sum", seed=5, payload_dim=4, fanout="all",
+                predicate="global", tol=1e-4, max_rounds=400)
+    r_sc = run_simulation(topo, RunConfig(**base))
+    r_rt = run_simulation(topo, RunConfig(**base, delivery="routed"))
+    assert r_sc.converged and r_rt.converged
+    np.testing.assert_allclose(np.asarray(r_sc.final_state.ratio),
+                               np.asarray(r_rt.final_state.ratio), atol=1e-5)
+
+
+def test_vector_sharded_matches_single(cpu_devices):
+    topo = build_topology("imp3D", 64, seed=1)
+    cfg = RunConfig(algorithm="push-sum", seed=7, payload_dim=4,
+                    max_rounds=400)
+    r1 = run_simulation(topo, cfg)
+    r4 = run_simulation_sharded(topo, cfg, mesh=make_mesh(
+        devices=cpu_devices[:4]))
+    assert r1.rounds == r4.rounds
+    np.testing.assert_allclose(np.asarray(r1.final_state.ratio),
+                               np.asarray(r4.final_state.ratio), atol=1e-5)
+
+
+def test_vector_rejects_invert_delivery():
+    with pytest.raises(ValueError, match="invert"):
+        RunConfig(algorithm="push-sum", payload_dim=4, delivery="invert")
+    with pytest.raises(ValueError, match="payload_dim"):
+        RunConfig(algorithm="push-sum", payload_dim=0)
+    with pytest.raises(ValueError, match="payload_dim|push-sum"):
+        RunConfig(algorithm="gossip", payload_dim=4)
+
+
+# ---------------------------------------------------------------------------
+# SGP
+# ---------------------------------------------------------------------------
+
+def _sgp_cfg(**kw):
+    base = dict(algorithm="push-sum", workload="sgp", payload_dim=4,
+                fanout="all", predicate="global", tol=1e-3, seed=7,
+                max_rounds=3000)
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def test_sgp_converges_and_is_deterministic():
+    """Fixed seed ⇒ identical final consensus loss, bit for bit — the
+    whole pipeline (data gen, gradient steps, mixing) is seed-pure."""
+    topo = build_topology("imp3D", 64, seed=1)
+    r1 = run_simulation(topo, _sgp_cfg())
+    r2 = run_simulation(topo, _sgp_cfg())
+    assert r1.converged
+    assert r1.rounds == r2.rounds
+    l1 = np.asarray(r1.final_state.loss)
+    assert np.array_equal(l1, np.asarray(r2.final_state.loss))
+    # the optimizer actually descended: final loss well under the data
+    # variance that x = 0 starts at
+    assert float(l1) < 0.5
+    # consensus: all nodes agree on the parameter vector
+    ratio = np.asarray(r1.final_state.ratio)
+    assert np.max(np.abs(ratio - ratio.mean(axis=0))) < 5e-3
+
+
+def test_sgp_train_loss_in_metrics():
+    topo = build_topology("full", 64, seed=1)
+    res = run_simulation(topo, _sgp_cfg(max_rounds=500))
+    losses = [m["train_loss"] for m in res.metrics if "train_loss" in m]
+    assert losses, "SGP chunks must report train_loss"
+    assert losses[-1] == pytest.approx(float(np.asarray(
+        res.final_state.loss)))
+
+
+def test_sgp_sharded_matches_single(cpu_devices):
+    topo = build_topology("imp3D", 64, seed=1)
+    r1 = run_simulation(topo, _sgp_cfg())
+    r4 = run_simulation_sharded(topo, _sgp_cfg(), mesh=make_mesh(
+        devices=cpu_devices[:4]))
+    assert r4.converged
+    assert r1.rounds == r4.rounds
+    assert float(np.asarray(r4.final_state.loss)) == pytest.approx(
+        float(np.asarray(r1.final_state.loss)), rel=1e-4)
+
+
+def test_sgp_config_validation():
+    for bad in (
+        dict(algorithm="gossip", workload="sgp"),
+        dict(algorithm="push-sum", workload="sgp", predicate="delta"),
+        dict(algorithm="push-sum", workload="sgp", accel="epd"),
+        dict(algorithm="push-sum", workload="sgp", delivery="invert"),
+        dict(algorithm="push-sum", workload="sgp", predicate="global",
+             lr=0.0),
+        dict(algorithm="push-sum", workload="sgp", predicate="global",
+             local_steps=0),
+        dict(algorithm="push-sum", workload="nonsense"),
+    ):
+        with pytest.raises(ValueError):
+            RunConfig(**bad)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["line", "full", "imp3D"])
+def test_sgp_acceptance_1024x16(kind):
+    """The ISSUE acceptance run: n=1024, d=16 synthetic least-squares,
+    deterministic convergence on line / full / imp3D."""
+    topo = build_topology(kind, 1024, seed=1)
+    cfg = _sgp_cfg(payload_dim=16, tol=1e-2, max_rounds=60000,
+                   chunk_rounds=512)
+    r1 = run_simulation(topo, cfg)
+    assert r1.converged, f"SGP did not converge on {kind}-1024"
+    r2 = run_simulation(topo, cfg)
+    assert r1.rounds == r2.rounds
+    assert np.array_equal(np.asarray(r1.final_state.loss),
+                          np.asarray(r2.final_state.loss))
+
+
+# ---------------------------------------------------------------------------
+# accelerated gossip (satellite 4)
+# ---------------------------------------------------------------------------
+
+def test_accel_conserves_mass_to_dtype_rounding():
+    """Property: the two-buffer affine combination has coefficients
+    summing to 1, so Σs and Σw are conserved whenever the mixing step
+    conserves them — in f64, to reduction rounding (~1e-12 over hundreds
+    of rounds), for both variants."""
+    topo = build_topology("line", 64, seed=1)
+    true_sum = sum(i / 64 for i in range(64))
+    jax.config.update("jax_enable_x64", True)
+    try:
+        for variant in ("epd", "chebyshev"):
+            cfg = RunConfig(algorithm="push-sum", seed=3, accel=variant,
+                            fanout="all", predicate="global", tol=1e-8,
+                            max_rounds=500, dtype=jnp.float64)
+            res = run_simulation(topo, cfg)
+            s = np.asarray(res.final_state.s, np.float64)
+            w = np.asarray(res.final_state.w, np.float64)
+            assert abs(s.sum() - true_sum) < 1e-9, variant
+            assert abs(w.sum() - 64) < 1e-9, variant
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_accel_chebyshev_converges_faster_than_plain():
+    """Fast proxy of the slow acceptance test: line-256, Chebyshev must
+    need at most half the rounds plain push-sum needs."""
+    topo = build_topology("line", 256, seed=1)
+    base = dict(algorithm="push-sum", seed=7, fanout="all",
+                predicate="global", tol=1e-4, chunk_rounds=1024,
+                max_rounds=60000)
+    r_acc = run_simulation(topo, RunConfig(**base, accel="chebyshev"))
+    assert r_acc.converged
+    r_plain = run_simulation(
+        topo, RunConfig(**{**base, "max_rounds": 2 * r_acc.rounds}))
+    assert not r_plain.converged, (
+        f"plain converged within 2x the accelerated rounds "
+        f"({r_acc.rounds} accelerated)"
+    )
+
+
+def test_accel_config_validation():
+    for bad in (
+        dict(algorithm="push-sum", accel="epd"),  # needs fanout all
+        dict(algorithm="push-sum", accel="epd", fanout="all",
+             delivery="invert"),
+        dict(algorithm="push-sum", accel="epd", fanout="all",
+             fault_plan={10: [1]}),
+        dict(algorithm="push-sum", accel="epd", fanout="all",
+             repair="rewire"),
+        dict(algorithm="push-sum", accel="chebyshev", fanout="all",
+             accel_lambda=1.0),
+        dict(algorithm="push-sum", accel="nonsense", fanout="all"),
+    ):
+        with pytest.raises(ValueError):
+            RunConfig(**bad)
+
+
+def test_accel_sharded_matches_single(cpu_devices):
+    topo = build_topology("imp3D", 64, seed=1)
+    cfg = RunConfig(algorithm="push-sum", seed=7, accel="chebyshev",
+                    fanout="all", predicate="global", tol=1e-5,
+                    max_rounds=3000)
+    r1 = run_simulation(topo, cfg)
+    r4 = run_simulation_sharded(topo, cfg, mesh=make_mesh(
+        devices=cpu_devices[:4]))
+    assert r1.converged and r4.converged
+    assert r1.rounds == r4.rounds
+    np.testing.assert_allclose(np.asarray(r1.final_state.ratio),
+                               np.asarray(r4.final_state.ratio), atol=1e-5)
+
+
+@pytest.mark.slow
+def test_accel_beats_plain_line1000_artifact():
+    """ISSUE acceptance: accelerated push-sum needs ≥2× fewer rounds than
+    plain on the 1000-node line graph; the margin lands in
+    artifacts/accel_line1000.json."""
+    topo = build_topology("line", 1000, seed=1)
+    base = dict(algorithm="push-sum", seed=7, fanout="all",
+                predicate="global", tol=1e-3, chunk_rounds=2048,
+                max_rounds=400000)
+    r_acc = run_simulation(topo, RunConfig(**base, accel="chebyshev"))
+    assert r_acc.converged, "chebyshev did not converge on line-1000"
+    cap = 2 * r_acc.rounds
+    r_plain = run_simulation(topo, RunConfig(**{**base, "max_rounds": cap}))
+    assert not r_plain.converged, (
+        f"plain push-sum converged within 2x the accelerated round count "
+        f"({r_acc.rounds})"
+    )
+    rec = {
+        "nodes": 1000,
+        "topology": "line",
+        "tol": base["tol"],
+        "accel": "chebyshev",
+        "accel_rounds": int(r_acc.rounds),
+        "plain_rounds_lower_bound": int(cap),
+        "plain_converged_at_bound": bool(r_plain.converged),
+        "speedup_lower_bound": float(cap) / float(r_acc.rounds),
+        "backend": jax.default_backend(),
+    }
+    os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
+    with open(ARTIFACT, "w") as fh:
+        json.dump(rec, fh, indent=2)
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: CLI flag validation (exit 2, argparse contract)
+# ---------------------------------------------------------------------------
+
+def _parse(args):
+    from gossipprotocol_tpu.cli import build_parser
+
+    return build_parser().parse_args(args)
+
+
+@pytest.mark.parametrize("flags", [
+    ["--payload-dim", "0"],
+    ["--payload-dim", "-3"],
+    ["--payload-dim", "two"],
+    ["--lr", "0"],
+    ["--lr", "-0.1"],
+    ["--local-steps", "0"],
+    ["--sgp-samples", "0"],
+    ["--loss-tol", "0"],
+    ["--accel", "quadratic"],
+    ["--accel-lambda", "0"],
+    ["--accel-lambda", "1"],
+    ["--accel-lambda", "1.5"],
+    ["--workload", "training"],
+])
+def test_cli_learn_flags_invalid_exit2(flags, capsys):
+    with pytest.raises(SystemExit) as e:
+        _parse(["64", "full", "push-sum"] + flags)
+    assert e.value.code == 2
+    capsys.readouterr()
+
+
+def test_cli_learn_flags_parse_and_land_in_config():
+    from gossipprotocol_tpu.cli import _build_config
+
+    args = _parse([
+        "64", "full", "push-sum", "--workload", "sgp", "--payload-dim", "8",
+        "--predicate", "global", "--fanout", "all", "--lr", "0.1",
+        "--local-steps", "2", "--sgp-samples", "4", "--loss-tol", "1e-4",
+    ])
+    cfg = _build_config(args, "push-sum", None, jnp)
+    assert (cfg.workload, cfg.payload_dim, cfg.lr, cfg.local_steps,
+            cfg.sgp_samples, cfg.loss_tol) == ("sgp", 8, 0.1, 2, 4, 1e-4)
+    args = _parse([
+        "64", "line", "push-sum", "--fanout", "all", "--accel", "chebyshev",
+        "--accel-lambda", "0.99",
+    ])
+    cfg = _build_config(args, "push-sum", None, jnp)
+    assert (cfg.accel, cfg.accel_lambda) == ("chebyshev", 0.99)
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: manifest / drift / report
+# ---------------------------------------------------------------------------
+
+def test_manifest_and_report_sgp(tmp_path, capsys):
+    """--telemetry-dir SGP run: the manifest records the learning knobs,
+    metric records carry per-dimension mass drift for vector runs, and
+    ``report`` renders the train-loss sparkline."""
+    from gossipprotocol_tpu.cli import main as cli_main
+    from gossipprotocol_tpu.obs.report import main as report_main
+
+    tdir = str(tmp_path / "tel")
+    code = cli_main([
+        "64", "imp3D", "push-sum", "--workload", "sgp", "--payload-dim",
+        "4", "--fanout", "all", "--predicate", "global", "--tol", "1e-3",
+        "--max-rounds", "3000", "--telemetry-dir", tdir, "--quiet",
+    ])
+    assert code == 0
+    capsys.readouterr()
+    with open(os.path.join(tdir, "run.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["config"]["payload_dim"] == 4
+    assert manifest["config"]["workload"] == "sgp"
+    assert manifest["config"]["accel"] == "off"
+    assert report_main([tdir]) == 0
+    out = capsys.readouterr().out
+    assert "train loss" in out
+    assert "convergence" in out
+
+
+def test_vector_mass_drift_is_max_over_dims(tmp_path, capsys):
+    """Vector telemetry run reports a scalar drift: max ULP over the d
+    per-dimension conserved sums."""
+    from gossipprotocol_tpu.cli import main as cli_main
+
+    tdir = str(tmp_path / "tel")
+    code = cli_main([
+        "64", "imp3D", "push-sum", "--payload-dim", "4", "--fanout", "all",
+        "--predicate", "global", "--tol", "1e-4", "--max-rounds", "2000",
+        "--telemetry-dir", tdir, "--quiet",
+    ])
+    assert code == 0
+    capsys.readouterr()
+    drifts = []
+    with open(os.path.join(tdir, "events.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("kind") == "metric":
+                d = rec["rec"].get("mass_drift_ulps")
+                if d is not None:
+                    drifts.append(d)
+    assert drifts, "vector run must report mass drift"
+    assert all(isinstance(d, (int, float)) for d in drifts)
+
+
+def test_ulp_drift_array_takes_max_over_dims():
+    from gossipprotocol_tpu.obs.counters import ulp_drift
+
+    base = np.asarray([1.0, 2.0, 3.0], np.float32)
+    v = base.copy()
+    assert ulp_drift(v, base) == 0.0
+    v2 = base.copy()
+    v2[1] = np.nextafter(np.float32(2.0), np.float32(3.0))
+    v2[2] = np.nextafter(
+        np.nextafter(np.float32(3.0), np.float32(4.0)), np.float32(4.0))
+    assert ulp_drift(v2, base) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# checkpointing the new states
+# ---------------------------------------------------------------------------
+
+def test_sgp_checkpoint_roundtrip(tmp_path):
+    from gossipprotocol_tpu.utils import checkpoint as ckpt
+
+    topo = build_topology("imp3D", 64, seed=1)
+    cfg = _sgp_cfg(max_rounds=200, checkpoint_dir=str(tmp_path),
+                   checkpoint_every=1, chunk_rounds=64)
+    res = run_simulation(topo, cfg)
+    path = ckpt.latest(str(tmp_path))
+    assert path is not None
+    state, meta = ckpt.load(path)
+    assert type(state).__name__ == "SGPState"
+    assert meta["workload"] == "sgp"
+    assert meta["payload_dim"] == 4
+    # resuming under a different payload width must be a trajectory
+    # mismatch, not a silent splice
+    assert not ckpt.field_matches(meta, "payload_dim", 16)
+    assert ckpt.field_matches(meta, "payload_dim", 4)
