@@ -61,10 +61,31 @@ def _probe_backend() -> None:
         sys.exit(3)
 
 
+def _bench_telemetry_dir() -> str:
+    """Persisted telemetry home for this bench session.
+
+    ``$BENCH_TELEMETRY_DIR`` overrides; otherwise the dir pairs with the
+    BENCH record the driver is about to write: ``artifacts/
+    bench_telemetry_rNN`` where NN = (max existing BENCH_r* at the repo
+    root) + 1. Persisting beats a throwaway tempdir — ``history`` indexes
+    these manifests, and regressions get archaeology instead of a number.
+    """
+    override = os.environ.get("BENCH_TELEMETRY_DIR")
+    if override:
+        return override
+    import glob
+    import re
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    seqs = [int(m.group(1))
+            for p in glob.glob(os.path.join(root, "BENCH_r*.json"))
+            for m in [re.search(r"BENCH_r(\d+)\.json$", p)] if m]
+    nn = (max(seqs) + 1) if seqs else 1
+    return os.path.join(root, "artifacts", f"bench_telemetry_r{nn:02d}")
+
+
 def main():
     _probe_backend()
-
-    import tempfile
 
     import jax
 
@@ -72,13 +93,14 @@ def main():
     from gossipprotocol_tpu.obs import Telemetry, write_manifest
 
     # --- headline: 1M-node imp3D gossip, single chip ---------------------
-    # Spans-only telemetry (counters=False leaves the compiled programs
-    # untouched, so the measurement is the measurement): the per-phase
-    # wall-time split lands in the BENCH record, and the full manifest /
-    # trace in $BENCH_TELEMETRY_DIR for archaeology on regressions.
-    tel_dir = os.environ.get("BENCH_TELEMETRY_DIR") or tempfile.mkdtemp(
-        prefix="bench_telemetry_")
-    tel = Telemetry(tel_dir, counters=False)
+    # Spans + per-round traces (counters=False keeps the heavier counter
+    # machinery out of the measured program; the trace buffer is three
+    # reductions per round and is part of the measured configuration):
+    # the per-phase wall-time split lands in the BENCH record, the full
+    # manifest + trace.jsonl persist under artifacts/bench_telemetry_rNN
+    # for archaeology on regressions ('history' indexes them).
+    tel_dir = _bench_telemetry_dir()
+    tel = Telemetry(tel_dir, counters=False, traces=True)
     n = int(os.environ.get("BENCH_NODES", 1_000_000))
     with tel.span("topology_build", kind="imp3D", nodes=n):
         topo = build_topology("imp3D", n, seed=0)
@@ -90,6 +112,11 @@ def main():
     write_manifest(tel, cfg, topo, res, backend=jax.default_backend())
     tel.close()
     phase_s = {name: agg["total_s"] for name, agg in tel.phase_rollup().items()}
+    # predicted-vs-actual for the headline run (obs/predict.py closes the
+    # loop in the manifest's prediction block; surface the ratio here so
+    # cross-run tracking sees predictor drift without opening manifests)
+    pred = getattr(tel, "prediction", None) or {}
+    prediction_ratio = pred.get("actual_over_predicted")
 
     # --- reference-scale point: 1000 nodes (Report.pdf p.1 ≈ 1150 ms) ----
     topo_1k = build_topology("imp3D", 1000, seed=0)
@@ -145,6 +172,10 @@ def main():
         # compile, chunks) + where the full manifest/trace landed
         "phase_s": phase_s,
         "telemetry_dir": tel_dir,
+        # actual/predicted rounds for the headline run (gossip log-spread
+        # heuristic — obs/predict.py); None if prediction was skipped
+        "prediction_ratio": prediction_ratio,
+        "predicted_rounds": pred.get("predicted_rounds"),
         **aux_vec,
     }
     # backup record on stderr BEFORE the 10M attempt: a process-fatal 10M

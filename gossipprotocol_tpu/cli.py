@@ -25,7 +25,11 @@ inversion), ``--metrics-out``, ``--checkpoint-dir``, ``--resume``,
 ``--devices`` (multi-chip sharding),
 ``--ws-k/--ws-beta`` (small-world knobs), ``--profile-dir``,
 ``--telemetry-dir`` (unified run telemetry; render a telemetry dir with
-the ``report`` subcommand: ``python -m gossipprotocol_tpu report DIR``).
+the ``report`` subcommand: ``python -m gossipprotocol_tpu report DIR``),
+``--round-budget``/``--trace-cap`` (convergence observatory: analytic
+round budgets and per-round trace downsampling; live-tail a running dir
+with ``watch DIR``, diff runs with ``report DIR --compare BASELINE``,
+track bench history with ``history``).
 Invalid
 input errors loudly — the reference silently
 no-ops on unknown topologies (``Program.fs:279``) and prints "option
@@ -101,6 +105,15 @@ def _build_config(args, algo, fault_schedule, jnp, alert_quorum=None,
     (caught by main and reported as exit 2, the bad-input contract)."""
     from gossipprotocol_tpu.engine import RunConfig
 
+    round_budget = args.round_budget
+    if round_budget is not None and round_budget != "auto":
+        try:
+            round_budget = int(round_budget)
+        except ValueError:
+            raise ValueError(
+                f"option invalid: --round-budget must be a positive integer "
+                f"or 'auto', got {args.round_budget!r}")
+
     return RunConfig(
         telemetry=telemetry,
         algorithm=algo,
@@ -136,6 +149,7 @@ def _build_config(args, algo, fault_schedule, jnp, alert_quorum=None,
         checkpoint_dir=args.checkpoint_dir,
         fault_schedule=fault_schedule,
         repair=args.repair,
+        round_budget=round_budget,
     )
 
 
@@ -475,6 +489,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the compiled programs are bitwise identical); set, "
                         "convergence results are STILL bitwise identical — "
                         "counters ride alongside and never feed back")
+    p.add_argument("--round-budget", type=str, default=None, metavar="N|auto",
+                   help="cap the run at N rounds with a structured "
+                        "over_budget record instead of grinding to "
+                        "--max-rounds; 'auto' derives the cap from the "
+                        "analytic round prediction (obs/predict.py): "
+                        "budget = 8x the spectral bound for push-sum, 8x "
+                        "the log-spread heuristic for gossip")
+    p.add_argument("--trace-cap", type=int, default=None, metavar="ROWS",
+                   help="per-round trace downsampling cap (default 4096, "
+                        "or $GOSSIP_TPU_TRACE_CAP): whenever another ROWS "
+                        "trace rows land in DIR/trace.jsonl the round "
+                        "stride doubles, bounding the file at "
+                        "ROWS*(1+log2(rounds/ROWS)) lines; needs "
+                        "--telemetry-dir")
     p.add_argument("--compile-cache", type=str,
                    default=os.environ.get(
                        "GOSSIP_TPU_COMPILE_CACHE",
@@ -504,6 +532,14 @@ def main(argv=None) -> int:
         from gossipprotocol_tpu.obs.report import main as report_main
 
         return report_main(effective_argv[1:])
+    if effective_argv and effective_argv[0] == "watch":
+        from gossipprotocol_tpu.obs.watch import main as watch_main
+
+        return watch_main(effective_argv[1:])
+    if effective_argv and effective_argv[0] == "history":
+        from gossipprotocol_tpu.obs.history import main as history_main
+
+        return history_main(effective_argv[1:])
 
     args = build_parser().parse_args(argv)
 
@@ -555,7 +591,8 @@ def main(argv=None) -> int:
     from gossipprotocol_tpu.obs.telemetry import NULL as _null_telemetry
     from gossipprotocol_tpu.utils.profiling import maybe_trace
 
-    tel = Telemetry(args.telemetry_dir) if args.telemetry_dir else _null_telemetry
+    tel = (Telemetry(args.telemetry_dir, trace_cap=args.trace_cap)
+           if args.telemetry_dir else _null_telemetry)
 
     algo = _ALGO_ALIASES.get(args.algorithm.lower())
     if algo is None:

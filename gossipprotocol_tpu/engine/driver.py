@@ -170,6 +170,15 @@ class RunConfig:
                                    # (tests/test_telemetry.py). NOT a
                                    # trajectory field for exactly that
                                    # reason
+    round_budget: Optional[Any] = None  # None = unlimited; an int caps
+                                   # the run at that many rounds with a
+                                   # structured over_budget record;
+                                   # "auto" derives the cap from the
+                                   # analytic round prediction
+                                   # (obs/predict.py) — requires a
+                                   # predictable topology. NOT a
+                                   # trajectory field: it only decides
+                                   # when the host loop stops
 
     @property
     def schedule(self):
@@ -373,6 +382,13 @@ class RunConfig:
                 "accel_lambda is a spectral bound γ = |λ₂(W)| and must lie "
                 "strictly in (0, 1)"
             )
+        if self.round_budget is not None and self.round_budget != "auto":
+            if not isinstance(self.round_budget, int) or isinstance(
+                self.round_budget, bool
+            ) or self.round_budget < 1:
+                raise ValueError(
+                    "round_budget must be None, a positive int, or 'auto'"
+                )
 
     def resolve_chunk_rounds(
         self, num_nodes: int, num_edges: Optional[int] = None
@@ -961,7 +977,8 @@ def mass_stats(state, all_sum=sum0) -> dict:
 
 
 def make_chunk_runner(round_core, done_fn, extra_stats=None,
-                      counter_fn=None, counter_slots=0):
+                      counter_fn=None, counter_slots=0,
+                      trace_fn=None, trace_slots=0):
     """jitted ``(state, nbrs, base_key, round_limit) -> (state, stats)``:
     advance rounds until global convergence or ``state.round ==
     round_limit``. The supervisor predicate is evaluated in the loop
@@ -974,8 +991,14 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
     the traced program is *identical* to before telemetry existed (the
     zero-cost-off contract); with it set the state trajectory is still
     bitwise unchanged because the buffer never feeds back into the round.
+
+    ``trace_fn`` (obs/trace.py contract) additionally folds a float32
+    ``[trace_slots, NUM_TRACE_COLS]`` per-round convergence-trace buffer
+    through the scan under the same contract: unset keeps the literal
+    counter-only (or pre-telemetry) program; set never feeds back into
+    the round, so the state trajectory stays bitwise identical.
     """
-    if counter_fn is None:
+    if counter_fn is None and trace_fn is None:
         def chunk(state, nbrs, base_key, round_limit):
             def body(s):
                 return round_core(s, nbrs, base_key)
@@ -988,26 +1011,66 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
 
         return jax.jit(chunk, donate_argnums=0)
 
+    if trace_fn is None:
+        def chunk(state, nbrs, base_key, round_limit):
+            start = state.round  # chunk entry round: buffer row 0
+
+            def body(carry):
+                s, buf = carry
+                s2 = round_core(s, nbrs, base_key)
+                delta = counter_fn(s, s2, nbrs, base_key, s.alive, None)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, delta[None, :], (s.round - start, jnp.int32(0)))
+                return s2, buf
+
+            def cond(carry):
+                s, _ = carry
+                return jnp.logical_and(~done_fn(s), s.round < round_limit)
+
+            buf0 = jnp.zeros((counter_slots, 3), jnp.int32)
+            final, buf = jax.lax.while_loop(cond, body, (state, buf0))
+            stats = stats_with_extra(final, done_fn, extra_stats)
+            stats["counters"] = buf
+            stats.update(mass_stats(final))
+            return final, stats
+
+        return jax.jit(chunk, donate_argnums=0)
+
+    from gossipprotocol_tpu.obs.trace import NUM_TRACE_COLS
+
     def chunk(state, nbrs, base_key, round_limit):
         start = state.round  # chunk entry round: buffer row 0
 
         def body(carry):
-            s, buf = carry
+            s, bufs = carry
             s2 = round_core(s, nbrs, base_key)
-            delta = counter_fn(s, s2, nbrs, base_key, s.alive, None)
-            buf = jax.lax.dynamic_update_slice(
-                buf, delta[None, :], (s.round - start, jnp.int32(0)))
-            return s2, buf
+            row = s.round - start
+            bufs = dict(bufs)
+            if counter_fn is not None:
+                delta = counter_fn(s, s2, nbrs, base_key, s.alive, None)
+                bufs["counters"] = jax.lax.dynamic_update_slice(
+                    bufs["counters"], delta[None, :], (row, jnp.int32(0)))
+            bufs["trace"] = jax.lax.dynamic_update_slice(
+                bufs["trace"],
+                trace_fn(s2).astype(jnp.float32)[None, :],
+                (row, jnp.int32(0)))
+            return s2, bufs
 
         def cond(carry):
             s, _ = carry
             return jnp.logical_and(~done_fn(s), s.round < round_limit)
 
-        buf0 = jnp.zeros((counter_slots, 3), jnp.int32)
-        final, buf = jax.lax.while_loop(cond, body, (state, buf0))
+        bufs0 = {
+            "trace": jnp.zeros((trace_slots, NUM_TRACE_COLS), jnp.float32),
+        }
+        if counter_fn is not None:
+            bufs0["counters"] = jnp.zeros((counter_slots, 3), jnp.int32)
+        final, bufs = jax.lax.while_loop(cond, body, (state, bufs0))
         stats = stats_with_extra(final, done_fn, extra_stats)
-        stats["counters"] = buf
-        stats.update(mass_stats(final))
+        stats["trace"] = bufs["trace"]
+        if counter_fn is not None:
+            stats["counters"] = bufs["counters"]
+            stats.update(mass_stats(final))
         return final, stats
 
     return jax.jit(chunk, donate_argnums=0)
@@ -1077,6 +1140,35 @@ def revive_rows(state, ids, cfg: RunConfig, num_nodes: int):
     )
 
 
+def compute_prediction(run_topo, cfg: RunConfig, tel) -> Optional[dict]:
+    """Analytic round prediction for this run (obs/predict.py), computed
+    once before compiling — on the host, from the topology CSR.
+
+    Returns None when prediction is off (no telemetry, no budget) or the
+    topology is too large / the configuration unpredictable; raises when
+    ``round_budget == "auto"`` cannot be resolved, since silently running
+    unbudgeted is exactly what the flag exists to prevent.
+    """
+    if not (tel.enabled or cfg.round_budget is not None):
+        return None
+    from gossipprotocol_tpu.obs.predict import maybe_predict_rounds
+
+    with tel.span("predict_rounds"):
+        pred = maybe_predict_rounds(
+            run_topo, cfg, required=(cfg.round_budget == "auto"))
+    if cfg.round_budget == "auto" and pred is None:
+        raise ValueError(
+            "round_budget='auto' needs an analytic round prediction, which "
+            "is unavailable for this configuration/topology (obs/predict.py "
+            "gates on edge count via $GOSSIP_TPU_PREDICT_EDGE_CAP); pass an "
+            "explicit --round-budget N instead"
+        )
+    if pred is not None and tel.enabled:
+        tel.prediction = pred
+        tel.event("prediction", **pred)
+    return pred
+
+
 def _mass_snapshot(state):
     """(Σs, Σw) over every row as float64 host sums — the invariant a
     repair rebuild must preserve bitwise. None for mass-free states
@@ -1100,6 +1192,7 @@ def _drive(
     trim: Callable[[Any], Any] = lambda s: s,
     rebuild: Optional[Callable] = None,
     run_topo: Optional[Topology] = None,
+    prediction: Optional[dict] = None,
 ) -> RunResult:
     """Shared host loop for the single-chip and sharded engines.
 
@@ -1114,6 +1207,11 @@ def _drive(
     the repair metrics record (plan-patch provenance). ``run_topo`` is
     the adjacency actually in force at entry — the birth topology unless
     a resume already replayed repair events past it.
+
+    ``prediction`` is the analytic round prediction (obs/predict.py)
+    computed by the engine before compiling; it resolves
+    ``cfg.round_budget == "auto"`` and is updated in place with the
+    actual outcome so the manifest records predicted-vs-actual.
     """
     from gossipprotocol_tpu.obs import as_telemetry
     from gossipprotocol_tpu.obs.counters import ulp_drift
@@ -1145,6 +1243,14 @@ def _drive(
     kills = {r: v for r, v in kills.items() if r >= cur_round}
     revives = {r: v for r, v in revives.items() if r >= cur_round}
     done = False
+    # round budget: an explicit int, or the analytic prediction's bound
+    # ("auto" — run_simulation guarantees `prediction` is present then)
+    budget = None
+    if cfg.round_budget == "auto":
+        budget = int(prediction["budget_rounds"])
+    elif cfg.round_budget is not None:
+        budget = int(cfg.round_budget)
+    over_budget = False
     checkpointing = bool(cfg.checkpoint_every and cfg.checkpoint_dir)
     # once per run, not per checkpoint (crc over the CSR)
     adjacency = ckpt_mod.topology_fingerprint(topo) if checkpointing else None
@@ -1286,7 +1392,12 @@ def _drive(
 
         next_event = min([*kills, *revives], default=cfg.max_rounds)
         round_limit = min(cur_round + chunk_rounds, cfg.max_rounds, next_event)
+        if budget is not None:
+            # stop exactly at the budget so the over-budget record carries
+            # the budget round, not the chunk boundary past it
+            round_limit = min(round_limit, budget)
 
+        chunk_start = cur_round
         with tel.span("chunk", round_start=cur_round,
                       round_limit=round_limit):
             state, stats = step(state, round_limit)
@@ -1297,7 +1408,13 @@ def _drive(
         cur_round = int(host.pop("round"))
         done = bool(host.pop("done"))
         counters = host.pop("counters", None)
+        trace_buf = host.pop("trace", None)
         chunk_mass = (host.pop("mass_s", None), host.pop("mass_w", None))
+        if trace_buf is not None and cur_round > chunk_start:
+            # valid prefix only: one row per round this chunk executed
+            tel.add_trace_rows(
+                chunk_start,
+                np.asarray(trace_buf)[: cur_round - chunk_start])
         rec = {"round": cur_round, **{k: v.item() for k, v in host.items()}}
         if counters is not None:
             # per-round int32 delta rows; cumulative totals as Python
@@ -1348,11 +1465,45 @@ def _drive(
                         adjacency=adjacency,
                     )
                 )
-        if done or stalled:
+        if budget is not None and not done and cur_round >= budget:
+            # structured over-budget exit: the run is not converging at
+            # the configured (or predicted) rate — stop burning rounds
+            # and leave an analyzable record instead of grinding on to
+            # max_rounds
+            over_budget = True
+            ob = {
+                "event": "over_budget",
+                "round": cur_round,
+                "budget_rounds": budget,
+                "budget_source": ("auto" if cfg.round_budget == "auto"
+                                  else "explicit"),
+            }
+            if prediction is not None:
+                ob["predicted_rounds"] = prediction.get("predicted_rounds")
+            metrics.append(ob)
+            tel.metric(ob)
+            tel.event("over_budget", **{k: v for k, v in ob.items()
+                                        if k != "event"})
+            if cfg.metrics_callback:
+                cfg.metrics_callback(ob)
+        if done or stalled or over_budget:
             break
     with tel.span("device_sync"):
         jax.block_until_ready(state)
     wall_ms = (time.perf_counter() - t0) * 1e3
+
+    if prediction is not None:
+        # close the loop analytically: the manifest's prediction block
+        # records what actually happened next to what was predicted
+        prediction["actual_rounds"] = cur_round
+        prediction["converged"] = bool(done)
+        prediction["over_budget"] = over_budget
+        pr = prediction.get("predicted_rounds")
+        if pr:
+            prediction["actual_over_predicted"] = round(cur_round / pr, 4)
+        tel.event("predicted_vs_actual",
+                  predicted_rounds=pr, actual_rounds=cur_round,
+                  converged=bool(done), over_budget=over_budget)
 
     return RunResult(
         converged=done,
@@ -1427,10 +1578,21 @@ def run_simulation(
             interpret=(default_platform() != "tpu"),
         )
 
+    def engine_trace_fn(ctopo):
+        if not tel.traces_on:
+            return None
+        from gossipprotocol_tpu.obs.trace import make_trace_fn
+
+        return make_trace_fn(ctopo, cfg)
+
+    prediction = compute_prediction(run_topo, cfg, tel)
+
     runner = make_chunk_runner(
         round_core, done_fn, extra_stats,
         counter_fn=engine_counter_fn(run_topo, all_alive, targets_alive),
         counter_slots=counter_slots,
+        trace_fn=engine_trace_fn(run_topo),
+        trace_slots=counter_slots,
     )
 
     t0 = time.perf_counter()
@@ -1460,6 +1622,8 @@ def run_simulation(
             core2, done2, extra2,
             counter_fn=engine_counter_fn(new_topo, aa2, ta2),
             counter_slots=counter_slots,
+            trace_fn=engine_trace_fn(new_topo),
+            trace_slots=counter_slots,
         )
         compiled2 = runner2.lower(st, nbrs2, base_key, jnp.int32(0)).compile()
 
@@ -1470,7 +1634,7 @@ def run_simulation(
         return step2, st, {"plan_patch_s": plan_patch_s}
 
     return _drive(topo, cfg, state, step, done_fn, compile_ms,
-                  rebuild=rebuild, run_topo=run_topo)
+                  rebuild=rebuild, run_topo=run_topo, prediction=prediction)
 
 
 def warm_start(step, state):

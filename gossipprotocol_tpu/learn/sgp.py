@@ -72,6 +72,20 @@ def sgp_init(
     )
 
 
+def sgp_trace_row(state: SGPState, *, all_sum=sum0, all_max=None):
+    """Observatory trace row for SGP: push-sum's consensus/mass columns
+    plus the mean train loss the state already carries (replicated by the
+    ``all_sum`` inside the round core, so no extra reduction is needed —
+    ``pushsum_trace_row`` picks the ``loss`` field up via ``hasattr``)."""
+    import jax.numpy as _jnp
+
+    from gossipprotocol_tpu.protocols.pushsum import pushsum_trace_row
+
+    if all_max is None:
+        all_max = _jnp.max
+    return pushsum_trace_row(state, all_sum=all_sum, all_max=all_max)
+
+
 def make_sgp_core(mix_core, *, lr: float, local_steps: int,
                   loss_tol: float, all_sum=sum0):
     """Wrap a fully-bound push-sum mixing core into an SGP round core.

@@ -1,0 +1,183 @@
+"""Anomaly rules over a run's telemetry records.
+
+:func:`anomaly_flags` is the one entry point: given the manifest, the
+chunk-granularity metric records, and (optionally) the per-round trace
+rows from ``trace.jsonl``, it returns human-readable flags for every
+condition the records can *prove* — no heuristics that fire on healthy
+runs, because CI asserts ``anomalies: none`` on clean chaos smokes.
+
+Rule groups:
+
+* **record rules** (manifest + metrics — the original ``report`` checks,
+  texts unchanged): did-not-converge, gossip stall, w-underflow,
+  link-loss drops, mass drift beyond ULP tolerance, missing manifest;
+* **counter rules**: sent ≠ delivered + dropped on runs where the
+  identity must hold (push-sum without churn — gossip legitimately
+  suppresses receiver-side, and dead receivers ignore shares);
+* **budget rules**: the run tripped an enforced ``round_budget`` (the
+  driver's structured ``over_budget`` record), or overshot the analytic
+  prediction's ``budget_factor × predicted`` bound;
+* **trace rules** (need ``trace.jsonl``, gated on *not converged* so a
+  finished run never trips them): residual plateau (stall) and residual
+  growth (divergence) over the last :data:`TRACE_WINDOW` trace rows.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+# trace rules look at the last this-many trace rows
+TRACE_WINDOW = 8
+# plateau: relative residual span across the window below this
+STALL_REL_SPAN = 1e-3
+# divergence: last residual at least this factor above the window's first
+DIVERGE_FACTOR = 2.0
+# mass drift beyond this many ULPs is flagged (matches the driver's own
+# loss-window bookkeeping slack)
+DRIFT_ULP_TOL = 64.0
+
+
+def _finite(x: Any) -> bool:
+    return isinstance(x, (int, float)) and math.isfinite(x)
+
+
+def _record_flags(manifest: Optional[Dict[str, Any]],
+                  metrics: List[Dict[str, Any]]) -> List[str]:
+    flags: List[str] = []
+    result = (manifest or {}).get("result")
+    if result is not None and not result.get("converged", True):
+        flags.append("DID NOT CONVERGE within the round budget")
+    if any(r.get("stalled") for r in metrics):
+        flags.append("gossip STALLED (live spreaders exhausted before quorum)")
+    peak_underflow = max((r.get("w_underflow", 0) or 0 for r in metrics),
+                         default=0)
+    if peak_underflow:
+        flags.append(
+            f"push-sum w-underflow: up to {peak_underflow} alive rows hit "
+            "w == 0 (dry-spell wall — consider f64)"
+        )
+    counters = (manifest or {}).get("counters")
+    if counters and counters.get("dropped", 0) > 0:
+        flags.append(f"{counters['dropped']} messages dropped by link loss")
+    drift = (manifest or {}).get("max_mass_drift_ulps")
+    wdrift = (manifest or {}).get("max_w_drift_ulps")
+    if drift is not None and max(drift, wdrift or 0.0) > DRIFT_ULP_TOL:
+        flags.append(
+            f"push-sum mass drift up to {max(drift, wdrift or 0.0):.0f} ULPs "
+            "(large for the dtype — check loss windows / dtype choice)"
+        )
+    return flags
+
+
+def _counter_flags(manifest: Optional[Dict[str, Any]]) -> List[str]:
+    """sent = delivered + dropped must hold exactly on push-sum runs with
+    no churn (every attempted share either moves mass or is dropped by a
+    loss window). Gossip breaks the identity by design (receiver-side
+    suppression is "sent, not delivered"), and dead receivers ignoring
+    shares break it under kill schedules — both are gated out rather
+    than special-cased, so this rule never fires on a healthy run."""
+    if manifest is None:
+        return []
+    counters = manifest.get("counters")
+    cfg = manifest.get("config", {})
+    sched = cfg.get("fault_schedule", {})
+    if (not counters
+            or cfg.get("algorithm") != "push-sum"
+            or sched.get("kill_events", 0) > 0):
+        return []
+    sent = int(counters.get("sent", 0))
+    delivered = int(counters.get("delivered", 0))
+    dropped = int(counters.get("dropped", 0))
+    if sent != delivered + dropped:
+        return [
+            f"counter imbalance: sent={sent} but delivered={delivered} + "
+            f"dropped={dropped} = {delivered + dropped} "
+            "(messages unaccounted for outside loss windows)"
+        ]
+    return []
+
+
+def _budget_flags(manifest: Optional[Dict[str, Any]],
+                  metrics: List[Dict[str, Any]]) -> List[str]:
+    flags: List[str] = []
+    pred = (manifest or {}).get("prediction")
+    over_recs = [r for r in metrics if r.get("event") == "over_budget"]
+    if over_recs or (pred and pred.get("over_budget")):
+        # the structured record carries the budget the driver actually
+        # enforced (explicit --round-budget N, not the auto prediction's
+        # bound) — prefer it over the prediction block's fields
+        rec = over_recs[-1] if over_recs else {}
+        flags.append(
+            f"EXCEEDED round budget: stopped at round "
+            f"{rec.get('round', (pred or {}).get('actual_rounds', '?'))} "
+            f"of budget "
+            f"{rec.get('budget_rounds', (pred or {}).get('budget_rounds', '?'))}"
+            f" (predicted "
+            f"{(pred or {}).get('predicted_rounds', rec.get('predicted_rounds', '?'))}"
+            f" rounds)"
+        )
+    elif (pred and pred.get("confidence") == "analytic"
+          and _finite(pred.get("actual_rounds"))
+          and _finite(pred.get("budget_rounds"))
+          and pred["actual_rounds"] > pred["budget_rounds"]):
+        flags.append(
+            f"round blowout: {pred['actual_rounds']} rounds > "
+            f"{pred.get('budget_factor', '?')}x the analytic prediction "
+            f"({pred.get('predicted_rounds', '?')} rounds)"
+        )
+    return flags
+
+
+def _trace_flags(manifest: Optional[Dict[str, Any]],
+                 trace: Optional[List[Dict[str, Any]]]) -> List[str]:
+    """Residual-shape rules. Only meaningful while the run has NOT
+    converged — a converged run's tail is flat at ~0 by definition, so
+    both rules gate on the manifest's converged bit (absent manifest =
+    crashed run = not converged, rules apply)."""
+    if not trace:
+        return []
+    result = (manifest or {}).get("result")
+    if result is not None and result.get("converged", False):
+        return []
+    residuals = [r["residual"] for r in trace
+                 if _finite(r.get("residual"))]
+    if len(residuals) < TRACE_WINDOW:
+        return []
+    window = residuals[-TRACE_WINDOW:]
+    first, last = window[0], window[-1]
+    lo, hi = min(window), max(window)
+    flags: List[str] = []
+    if last >= first * DIVERGE_FACTOR and last > 0:
+        flags.append(
+            f"residual DIVERGING: {first:.3e} -> {last:.3e} over the last "
+            f"{TRACE_WINDOW} trace rows"
+        )
+    elif hi > 0 and (hi - lo) <= STALL_REL_SPAN * hi:
+        flags.append(
+            f"residual PLATEAU: stuck at {last:.3e} over the last "
+            f"{TRACE_WINDOW} trace rows without converging"
+        )
+    return flags
+
+
+def anomaly_flags(
+    manifest: Optional[Dict[str, Any]],
+    metrics: List[Dict[str, Any]],
+    trace: Optional[List[Dict[str, Any]]] = None,
+) -> List[str]:
+    """Every anomaly the records prove, most fundamental first.
+
+    ``manifest`` is the parsed ``run.json`` (None when the run died
+    before writing it), ``metrics`` the chunk metric records from
+    ``events.jsonl``, ``trace`` the rows
+    :func:`~gossipprotocol_tpu.obs.trace.load_trace` returned (optional —
+    trace rules are skipped without it).
+    """
+    flags = _record_flags(manifest, metrics)
+    flags += _counter_flags(manifest)
+    flags += _budget_flags(manifest, metrics)
+    flags += _trace_flags(manifest, trace)
+    if manifest is None:
+        flags.append("run.json missing: run likely crashed before finishing")
+    return flags

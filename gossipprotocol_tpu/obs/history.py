@@ -1,0 +1,211 @@
+"""Cross-run regression tracking: a run index over bench records + manifests.
+
+Every recorded bench session leaves a ``BENCH_rNN.json`` at the repo
+root and (since the observatory) a persisted telemetry dir under
+``artifacts/bench_telemetry_rNN/``; every ``--telemetry-dir`` run leaves
+a ``run.json`` manifest. :func:`build_index` sweeps both into one
+chronological ``artifacts/run_index.jsonl`` — a flat, append-friendly
+record stream any later tool (or a human with ``jq``) can diff.
+
+``python -m gossipprotocol_tpu history [ROOT]`` rebuilds the index and
+prints the headline-metric trajectory: one line per bench round with the
+value, the delta against the previous round, and the predicted-vs-actual
+round ratio when the manifest recorded one. ``--metric SUBSTR`` filters
+to matching metric names. Exit 0 on success, 2 when ROOT has no bench
+records at all.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, TextIO
+
+from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
+
+INDEX_RELPATH = os.path.join("artifacts", "run_index.jsonl")
+
+_BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _load_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _bench_records(root: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _BENCH_RE.search(os.path.basename(path))
+        doc = _load_json(path)
+        if m is None or doc is None:
+            continue
+        parsed = doc.get("parsed") or {}
+        rec: Dict[str, Any] = {
+            "v": SCHEMA_VERSION,
+            "kind": "bench",
+            "seq": int(m.group(1)),
+            "source": os.path.basename(path),
+            "metric": parsed.get("metric"),
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit"),
+            "rounds": parsed.get("rounds"),
+            "nodes": parsed.get("nodes"),
+            "backend": parsed.get("backend"),
+            "rc": doc.get("rc"),
+        }
+        if isinstance(parsed.get("phase_s"), dict):
+            rec["phase_s"] = parsed["phase_s"]
+        if parsed.get("prediction_ratio") is not None:
+            rec["prediction_ratio"] = parsed["prediction_ratio"]
+        out.append(rec)
+    return out
+
+
+def _manifest_records(root: str) -> List[Dict[str, Any]]:
+    """Manifests under ``artifacts/`` (persisted bench telemetry and any
+    run the user parked there), up to two levels deep."""
+    out: List[Dict[str, Any]] = []
+    pats = (os.path.join(root, "artifacts", "*", "run.json"),
+            os.path.join(root, "artifacts", "*", "*", "run.json"))
+    seen = set()
+    for pat in pats:
+        for path in sorted(glob.glob(pat)):
+            if path in seen:
+                continue
+            seen.add(path)
+            doc = _load_json(path)
+            if doc is None or doc.get("kind") != "run_manifest":
+                continue
+            cfg = doc.get("config") or {}
+            topo = doc.get("topology") or {}
+            result = doc.get("result") or {}
+            pred = doc.get("prediction") or {}
+            out.append({
+                "v": SCHEMA_VERSION,
+                "kind": "run",
+                "source": os.path.relpath(path, root),
+                "algorithm": cfg.get("algorithm"),
+                "topology": topo.get("kind"),
+                "num_nodes": topo.get("num_nodes"),
+                "backend": doc.get("backend"),
+                "converged": result.get("converged"),
+                "rounds": result.get("rounds"),
+                "wall_ms": result.get("wall_ms"),
+                "predicted_rounds": pred.get("predicted_rounds"),
+                "actual_over_predicted": pred.get("actual_over_predicted"),
+            })
+    return out
+
+
+def build_index(root: str, write: bool = True) -> List[Dict[str, Any]]:
+    """Sweep ROOT for bench records and manifests; optionally (re)write
+    ``artifacts/run_index.jsonl`` (atomic tmp+rename — the index is a
+    derived artifact, rebuilt whole each time)."""
+    records = _bench_records(root) + _manifest_records(root)
+    if write and records:
+        path = os.path.join(root, INDEX_RELPATH)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            for rec in records:
+                fh.write(json.dumps(rec) + "\n")
+        os.replace(tmp, path)
+    return records
+
+
+def _fmt_delta(cur: Any, prev: Any) -> str:
+    if not isinstance(cur, (int, float)) or not isinstance(prev, (int, float)):
+        return ""
+    if prev <= 0:
+        return ""
+    d = (cur - prev) / prev
+    return f"  {d:+.1%}"
+
+
+def render_history(records: List[Dict[str, Any]], out: TextIO,
+                   metric_filter: Optional[str] = None) -> None:
+    benches = [r for r in records if r["kind"] == "bench"
+               and r.get("metric")
+               and (metric_filter is None or metric_filter in r["metric"])]
+    by_metric: Dict[str, List[Dict[str, Any]]] = {}
+    for r in benches:
+        by_metric.setdefault(r["metric"], []).append(r)
+    for metric, rows in by_metric.items():
+        rows.sort(key=lambda r: r["seq"])
+        out.write(f"{metric}:\n")
+        prev = None
+        for r in rows:
+            val = r.get("value")
+            line = (f"  r{r['seq']:02d}  "
+                    + (f"{val:10.3f} {r.get('unit') or ''}"
+                       if isinstance(val, (int, float)) else f"{val!r:>10}"))
+            if r.get("rounds") is not None:
+                line += f"  {r['rounds']} rounds"
+            if r.get("backend"):
+                line += f"  [{r['backend']}]"
+            line += _fmt_delta(val, (prev or {}).get("value"))
+            if r.get("prediction_ratio") is not None:
+                line += f"  pred-ratio {r['prediction_ratio']:.2f}"
+            out.write(line + "\n")
+            prev = r
+        out.write("\n")
+    runs = [r for r in records if r["kind"] == "run"]
+    if runs:
+        out.write(f"indexed manifests ({len(runs)}):\n")
+        for r in runs:
+            line = (f"  {r.get('algorithm', '?')} on "
+                    f"{r.get('topology', '?')}-{r.get('num_nodes', '?')}: ")
+            if r.get("rounds") is not None:
+                line += f"{r['rounds']} rounds"
+            if isinstance(r.get("wall_ms"), (int, float)):
+                line += f", {r['wall_ms']:.1f} ms"
+            if r.get("actual_over_predicted") is not None:
+                line += f", {r['actual_over_predicted']:.2f}x predicted"
+            line += f"  ({r['source']})"
+            out.write(line + "\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print("usage: python -m gossipprotocol_tpu history [ROOT] "
+              "[--metric SUBSTR] [--no-write]")
+        return 0
+    root = "."
+    metric: Optional[str] = None
+    write = True
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--metric":
+            if i + 1 >= len(argv):
+                print("history: --metric needs a value", file=sys.stderr)
+                return 2
+            metric = argv[i + 1]
+            i += 2
+        elif a == "--no-write":
+            write = False
+            i += 1
+        else:
+            root = a
+            i += 1
+    if not os.path.isdir(root):
+        print(f"history: {root!r} is not a directory", file=sys.stderr)
+        return 2
+    records = build_index(root, write=write)
+    if not records:
+        print(f"history: no BENCH_r*.json or manifests under {root!r}",
+              file=sys.stderr)
+        return 2
+    render_history(records, sys.stdout, metric_filter=metric)
+    if write:
+        print(f"index: {os.path.join(root, INDEX_RELPATH)} "
+              f"({len(records)} records)")
+    return 0

@@ -100,6 +100,12 @@ def build_manifest(
         "max_w_drift_ulps": (
             tel.max_w_drift_ulps if tel.counters_on else None
         ),
+        # obs/predict.py round prediction, updated by the driver with the
+        # actual outcome (predicted_rounds / actual_rounds / over_budget)
+        "prediction": getattr(tel, "prediction", None),
+        # trace.jsonl bookkeeping (rows written, final stride, cap)
+        "trace": (tel.trace_summary()
+                  if hasattr(tel, "trace_summary") else None),
     }
     if result is not None:
         err = result.estimate_error

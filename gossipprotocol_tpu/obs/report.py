@@ -1,14 +1,27 @@
 """Human-readable rendering of a telemetry dir.
 
 ``python -m gossipprotocol_tpu report DIR`` reads what a ``--telemetry-dir``
-run left behind — ``run.json``, ``events.jsonl`` — and prints the
-summary you'd want before trusting (or debugging) the run: where the wall
-time went, what the counters totalled, how convergence progressed, and
-any anomaly the records can prove.
+run left behind — ``run.json``, ``events.jsonl``, ``trace.jsonl`` — and
+prints the summary you'd want before trusting (or debugging) the run:
+where the wall time went, what the counters totalled, how convergence
+progressed round by round, how the analytic prediction compared to
+reality, and any anomaly the records can prove
+(:mod:`gossipprotocol_tpu.obs.anomaly`).
 
-Exit codes: 0 on success, 2 when DIR is missing/empty or the records
-carry a schema major version newer than this reader (absent ``"v"``
-means version 1 — see :mod:`gossipprotocol_tpu.utils.metrics`).
+A dir with events but no manifest (killed run, or one still running) gets
+a *partial* report under a ``run incomplete`` banner — partial telemetry
+is an answer, not an error.
+
+``report DIR --compare BASELINE_DIR [--threshold F]`` additionally diffs
+the run against a baseline telemetry dir: rounds, time-to-convergence,
+and per-phase wall time, exiting 3 when either regresses beyond the
+threshold (default 0.2 = 20%).
+
+Exit codes: 0 on success (including partial reports), 2 when DIR is
+missing/empty or the records carry a schema major version newer than
+this reader (absent ``"v"`` means version 1 — see
+:mod:`gossipprotocol_tpu.utils.metrics`), 3 when ``--compare`` found a
+regression beyond the threshold.
 """
 
 from __future__ import annotations
@@ -16,11 +29,19 @@ from __future__ import annotations
 import json
 import os
 import sys
-from typing import Any, Dict, List, Optional, TextIO
+from typing import Any, Dict, List, Optional, TextIO, Tuple
 
+from gossipprotocol_tpu.obs.anomaly import anomaly_flags  # re-export
+from gossipprotocol_tpu.obs.trace import load_trace
 from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
 
+__all__ = ["ReportError", "load_telemetry_dir", "sparkline",
+           "anomaly_flags", "render", "compare", "main"]
+
 _SPARK = "▁▂▃▄▅▆▇█"
+
+# --compare: relative slowdown beyond this fraction is a regression
+COMPARE_THRESHOLD_DEFAULT = 0.2
 
 
 class ReportError(Exception):
@@ -37,9 +58,9 @@ def _check_version(doc: Dict[str, Any], where: str) -> None:
 
 
 def load_telemetry_dir(path: str) -> Dict[str, Any]:
-    """Read ``run.json`` + ``events.jsonl``; either may be absent (a run
-    killed before close still leaves partial events), both absent is an
-    error."""
+    """Read ``run.json`` + ``events.jsonl`` + ``trace.jsonl``; any may be
+    absent (a run killed before close still leaves partial events and
+    trace rows), all absent is an error."""
     manifest: Optional[Dict[str, Any]] = None
     events: List[Dict[str, Any]] = []
     mpath = os.path.join(path, "run.json")
@@ -61,12 +82,13 @@ def load_telemetry_dir(path: str) -> Dict[str, Any]:
                 if i == 0:
                     _check_version(rec, epath)
                 events.append(rec)
-    if manifest is None and not events:
+    trace = load_trace(os.path.join(path, "trace.jsonl"))
+    if manifest is None and not events and not trace:
         raise ReportError(
             f"no telemetry found under {path!r} (expected run.json and/or "
             "events.jsonl — was the run launched with --telemetry-dir?)"
         )
-    return {"manifest": manifest, "events": events}
+    return {"manifest": manifest, "events": events, "trace": trace}
 
 
 def sparkline(values: List[float], width: int = 40) -> str:
@@ -113,40 +135,18 @@ def _metric_recs(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return [r["rec"] for r in events if r.get("kind") == "metric" and "rec" in r]
 
 
-def anomaly_flags(manifest: Optional[Dict[str, Any]],
-                  metrics: List[Dict[str, Any]]) -> List[str]:
-    flags: List[str] = []
-    result = (manifest or {}).get("result")
-    if result is not None and not result.get("converged", True):
-        flags.append("DID NOT CONVERGE within the round budget")
-    if any(r.get("stalled") for r in metrics):
-        flags.append("gossip STALLED (live spreaders exhausted before quorum)")
-    peak_underflow = max((r.get("w_underflow", 0) or 0 for r in metrics),
-                        default=0)
-    if peak_underflow:
-        flags.append(
-            f"push-sum w-underflow: up to {peak_underflow} alive rows hit "
-            "w == 0 (dry-spell wall — consider f64)"
-        )
-    counters = (manifest or {}).get("counters")
-    if counters and counters.get("dropped", 0) > 0:
-        flags.append(f"{counters['dropped']} messages dropped by link loss")
-    drift = (manifest or {}).get("max_mass_drift_ulps")
-    wdrift = (manifest or {}).get("max_w_drift_ulps")
-    if drift is not None and max(drift, wdrift or 0.0) > 64.0:
-        flags.append(
-            f"push-sum mass drift up to {max(drift, wdrift or 0.0):.0f} ULPs "
-            "(large for the dtype — check loss windows / dtype choice)"
-        )
-    if manifest is None:
-        flags.append("run.json missing: run likely crashed before finishing")
-    return flags
-
-
 def render(data: Dict[str, Any], out: TextIO) -> None:
     manifest = data["manifest"]
     events = data["events"]
+    trace = data.get("trace") or []
     metrics = _metric_recs(events)
+
+    # incomplete banner -------------------------------------------------
+    if manifest is None:
+        out.write(
+            "*** run incomplete: no run.json yet (crashed or still "
+            "running) — partial report from events/trace ***\n"
+        )
 
     # header -------------------------------------------------------------
     if manifest is not None:
@@ -173,6 +173,25 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
                 + (f", estimate error {err:.3e}" if err is not None else "")
                 + "\n"
             )
+
+    # prediction ---------------------------------------------------------
+    pred = (manifest or {}).get("prediction")
+    if pred:
+        gamma = pred.get("gamma")
+        gpart = f", gamma={gamma:.6f}" if isinstance(gamma, float) else ""
+        out.write(
+            f"prediction: {pred.get('model', '?')}"
+            f" ({pred.get('confidence', '?')}{gpart})"
+            f" predicted {pred.get('predicted_rounds', '?')} rounds,"
+            f" budget {pred.get('budget_rounds', '?')}"
+        )
+        if pred.get("actual_rounds") is not None:
+            ratio = pred.get("actual_over_predicted")
+            out.write(
+                f"; actual {pred['actual_rounds']}"
+                + (f" ({ratio:.2f}x predicted)" if ratio is not None else "")
+            )
+        out.write("\n")
 
     # phase table --------------------------------------------------------
     phases = (manifest or {}).get("phases") or _phases_from_events(events)
@@ -218,12 +237,30 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
     if metrics:
         frac = [
             (r.get("converged", 0) / r["alive"]) if r.get("alive") else 0.0
-            for r in metrics
+            for r in metrics if "alive" in r or "converged" in r
         ]
-        first, last = metrics[0].get("round", "?"), metrics[-1].get("round", "?")
+        chunked = [r for r in metrics if "round" in r]
+        if frac and chunked:
+            first, last = chunked[0].get("round", "?"), chunked[-1].get("round", "?")
+            out.write(
+                f"\nconvergence (fraction of alive nodes, rounds {first}..{last}):\n"
+                f"  {sparkline(frac)}  {frac[-1] * 100:.1f}% final\n"
+            )
+
+    # per-round residual trace -------------------------------------------
+    residuals = [
+        (r["round"], r["residual"]) for r in trace
+        if isinstance(r.get("residual"), (int, float))
+        and r["residual"] == r["residual"]
+    ]
+    if residuals:
+        vals = [v for _, v in residuals]
+        tsum = (manifest or {}).get("trace") or {}
         out.write(
-            f"\nconvergence (fraction of alive nodes, rounds {first}..{last}):\n"
-            f"  {sparkline(frac)}  {frac[-1] * 100:.1f}% final\n"
+            f"\nresidual trace (per-round, rounds {residuals[0][0]}.."
+            f"{residuals[-1][0]}, {len(residuals)} rows"
+            + (f", stride {tsum['stride']}" if tsum.get("stride") else "")
+            + f"):\n  {sparkline(vals)}  {vals[-1]:.3e} final\n"
         )
 
     # train-loss sparkline (SGP runs record a "train_loss" per chunk) -----
@@ -240,7 +277,7 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
         )
 
     # anomalies ----------------------------------------------------------
-    flags = anomaly_flags(manifest, metrics)
+    flags = anomaly_flags(manifest, metrics, trace)
     if flags:
         out.write("\nanomalies:\n")
         for f in flags:
@@ -249,13 +286,127 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
         out.write("\nanomalies: none\n")
 
 
+# ---------------------------------------------------------------------------
+# --compare: regression diff against a baseline telemetry dir
+
+
+def _run_summary(data: Dict[str, Any]) -> Dict[str, Any]:
+    manifest = data.get("manifest") or {}
+    result = manifest.get("result") or {}
+    pred = manifest.get("prediction") or {}
+    return {
+        "label": (f"{manifest.get('config', {}).get('algorithm', '?')} on "
+                  f"{manifest.get('topology', {}).get('kind', '?')}-"
+                  f"{manifest.get('topology', {}).get('num_nodes', '?')}"),
+        "converged": result.get("converged"),
+        "rounds": result.get("rounds"),
+        "wall_ms": result.get("wall_ms"),
+        "compile_ms": result.get("compile_ms"),
+        "phases": manifest.get("phases") or {},
+        "ratio": pred.get("actual_over_predicted"),
+    }
+
+
+def _rel_delta(cur: Optional[float], base: Optional[float]) -> Optional[float]:
+    if not isinstance(cur, (int, float)) or not isinstance(base, (int, float)):
+        return None
+    if base <= 0:
+        return None
+    return (cur - base) / base
+
+
+def compare(data: Dict[str, Any], baseline: Dict[str, Any], out: TextIO,
+            threshold: float = COMPARE_THRESHOLD_DEFAULT) -> bool:
+    """Diff ``data`` against ``baseline``; returns True when rounds or
+    time-to-convergence regressed beyond ``threshold`` (relative).
+    Per-phase wall deltas are reported but never gate — compile and I/O
+    phases are too noisy across machines to fail a build on."""
+    cur, base = _run_summary(data), _run_summary(baseline)
+    out.write(f"\ncompare: {cur['label']} vs baseline {base['label']}\n")
+    if cur["label"] != base["label"]:
+        out.write("  (warning: configs differ — deltas may be meaningless)\n")
+    regressed = False
+    for key, unit, gate in (("rounds", "rounds", True),
+                            ("wall_ms", "ms", True),
+                            ("compile_ms", "ms", False)):
+        d = _rel_delta(cur[key], base[key])
+        if d is None:
+            continue
+        mark = ""
+        if gate and d > threshold:
+            regressed = True
+            mark = f"  REGRESSION (> {threshold:.0%} threshold)"
+        out.write(
+            f"  {key:<11} {cur[key]:>12.1f} vs {base[key]:>12.1f} {unit}"
+            f"  ({d:+.1%}){mark}\n"
+        )
+    if cur["ratio"] is not None and base["ratio"] is not None:
+        out.write(
+            f"  {'pred ratio':<11} {cur['ratio']:>12.2f} vs "
+            f"{base['ratio']:>12.2f} x  (actual/predicted rounds)\n"
+        )
+    shared = sorted(set(cur["phases"]) & set(base["phases"]))
+    for name in shared:
+        d = _rel_delta(cur["phases"][name].get("total_s"),
+                       base["phases"][name].get("total_s"))
+        if d is None:
+            continue
+        out.write(
+            f"  phase {name:<16} {cur['phases'][name]['total_s']:>9.3f} vs "
+            f"{base['phases'][name]['total_s']:>9.3f} s  ({d:+.1%})\n"
+        )
+    if regressed:
+        out.write(f"compare: REGRESSION beyond {threshold:.0%} detected\n")
+    else:
+        out.write(f"compare: within {threshold:.0%} of baseline\n")
+    return regressed
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m gossipprotocol_tpu report TELEMETRY_DIR",
-              file=sys.stderr if not argv else sys.stdout)
+        print(
+            "usage: python -m gossipprotocol_tpu report TELEMETRY_DIR "
+            "[--compare BASELINE_DIR] [--threshold F]",
+            file=sys.stderr if not argv else sys.stdout,
+        )
         return 0 if argv else 2
-    path = argv[0]
+    # `--compare` is a mode flag; dirs are positional in order, so both
+    # `report DIR --compare BASELINE` and `report --compare DIR BASELINE`
+    # read as (current, baseline)
+    compare_mode = False
+    threshold = COMPARE_THRESHOLD_DEFAULT
+    paths: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--compare":
+            compare_mode = True
+            i += 1
+        elif a == "--threshold":
+            if i + 1 >= len(argv):
+                print("report: --threshold needs a value", file=sys.stderr)
+                return 2
+            try:
+                threshold = float(argv[i + 1])
+            except ValueError:
+                print(f"report: bad --threshold {argv[i + 1]!r}",
+                      file=sys.stderr)
+                return 2
+            i += 2
+        else:
+            paths.append(a)
+            i += 1
+    if not paths:
+        print("report: missing TELEMETRY_DIR", file=sys.stderr)
+        return 2
+    path = paths[0]
+    baseline_dir: Optional[str] = None
+    if compare_mode or len(paths) > 1:
+        if len(paths) < 2:
+            print("report: --compare needs a BASELINE_DIR", file=sys.stderr)
+            return 2
+        baseline_dir = paths[1]
     if not os.path.isdir(path):
         print(f"report: {path!r} is not a directory", file=sys.stderr)
         return 2
@@ -265,4 +416,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"report: {e}", file=sys.stderr)
         return 2
     render(data, sys.stdout)
+    if baseline_dir is not None:
+        if not os.path.isdir(baseline_dir):
+            print(f"report: baseline {baseline_dir!r} is not a directory",
+                  file=sys.stderr)
+            return 2
+        try:
+            baseline = load_telemetry_dir(baseline_dir)
+        except ReportError as e:
+            print(f"report: baseline: {e}", file=sys.stderr)
+            return 2
+        if compare(data, baseline, sys.stdout, threshold=threshold):
+            return 3
     return 0

@@ -68,14 +68,28 @@ class Telemetry:
         message counters through every chunk — a real (small) per-round
         cost.  ``bench.py`` passes False: spans and manifest only, with
         the compiled programs untouched.
+    traces:
+        Fold the per-round observatory trace buffer through every chunk
+        (:mod:`gossipprotocol_tpu.obs.trace`) and append rows to
+        ``trace.jsonl``.  ``None`` (default) follows ``counters``, so
+        pre-trace constructions keep their exact compiled programs.
+    trace_cap:
+        Downsampling cap for ``trace.jsonl`` (rows before the stride
+        doubles); ``None`` = ``$GOSSIP_TPU_TRACE_CAP`` or 4096.
     """
 
     enabled = True
+    prediction = None  # obs.predict round prediction, set by the driver
 
-    def __init__(self, out_dir: str, *, counters: bool = True):
+    def __init__(self, out_dir: str, *, counters: bool = True,
+                 traces: Optional[bool] = None,
+                 trace_cap: Optional[int] = None):
         self.dir = os.path.abspath(out_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.counters_on = bool(counters)
+        self.traces_on = bool(counters if traces is None else traces)
+        self._trace_cap = trace_cap
+        self._trace_writer = None
         self._t0 = time.perf_counter()
         self._epoch0 = time.time()
         self._depth = 0
@@ -138,6 +152,30 @@ class Telemetry:
     def note_mass_drift(self, s_ulps: float, w_ulps: float) -> None:
         self.max_mass_drift_ulps = max(self.max_mass_drift_ulps, float(s_ulps))
         self.max_w_drift_ulps = max(self.max_w_drift_ulps, float(w_ulps))
+
+    # ---------------------------------------------------------------- traces
+
+    def add_trace_rows(self, start_round: int, rows) -> None:
+        """Append one chunk's per-round trace rows (rounds
+        ``start_round+1 ..``) to ``trace.jsonl`` — no-op with traces off."""
+        if not self.traces_on or self._closed:
+            return
+        from gossipprotocol_tpu.obs.trace import TraceWriter
+
+        if self._trace_writer is None:
+            self._trace_writer = TraceWriter(
+                os.path.join(self.dir, "trace.jsonl"), cap=self._trace_cap)
+        stride0 = self._trace_writer.stride
+        self._trace_writer.add(start_round, rows)
+        if self._trace_writer.stride != stride0:
+            self.event("trace_downsample",
+                       stride=self._trace_writer.stride,
+                       rows_written=self._trace_writer.rows_written)
+
+    def trace_summary(self) -> Optional[Dict[str, int]]:
+        if self._trace_writer is None:
+            return None
+        return self._trace_writer.summary()
 
     # -------------------------------------------------------------- outputs
 
@@ -202,6 +240,8 @@ class Telemetry:
             self.write_trace()
             self._emit({"kind": "end", "wall_s": round(self.wall_s(), 6)})
         finally:
+            if self._trace_writer is not None:
+                self._trace_writer.close()
             self._events.close()
 
     def __enter__(self) -> "Telemetry":
@@ -228,6 +268,8 @@ class NullTelemetry:
 
     enabled = False
     counters_on = False
+    traces_on = False
+    prediction = None
     dir = None
 
     @contextmanager
@@ -245,6 +287,12 @@ class NullTelemetry:
 
     def note_mass_drift(self, s_ulps: float, w_ulps: float) -> None:
         pass
+
+    def add_trace_rows(self, start_round: int, rows) -> None:
+        pass
+
+    def trace_summary(self) -> Optional[Dict[str, int]]:
+        return None
 
     def wall_s(self) -> float:
         return 0.0

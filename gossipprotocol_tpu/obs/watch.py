@@ -1,0 +1,148 @@
+"""Live monitoring of a telemetry dir while the run is still going.
+
+``python -m gossipprotocol_tpu watch DIR`` tails what the run has
+written so far — ``events.jsonl`` and ``trace.jsonl`` grow line by line,
+``run.json`` lands at the end — and refreshes a compact status frame
+every ``--interval`` seconds: current round, residual, converged
+fraction, message totals, and any anomaly the partial records already
+prove. On a tty each refresh clears the screen; piped output gets
+separator-delimited frames instead (so CI logs stay readable).
+
+Exits 0 as soon as the manifest reports a result (the run finished) or
+after ``--max-frames`` refreshes; exits 2 when DIR is not a directory.
+A dir that has no telemetry *yet* is not an error — watch waits for it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, TextIO
+
+from gossipprotocol_tpu.obs.anomaly import anomaly_flags
+from gossipprotocol_tpu.obs.report import (
+    ReportError,
+    _metric_recs,
+    load_telemetry_dir,
+    sparkline,
+)
+
+INTERVAL_DEFAULT = 2.0
+
+
+def _frame(data: Dict[str, Any], out: TextIO) -> bool:
+    """Write one status frame; returns True when the run is finished."""
+    manifest = data["manifest"]
+    events = data["events"]
+    trace = data.get("trace") or []
+    metrics = _metric_recs(events)
+
+    result = (manifest or {}).get("result")
+    chunked = [r for r in metrics if "round" in r]
+    last = chunked[-1] if chunked else {}
+    rnd = (result or {}).get("rounds", last.get("round", 0))
+    out.write(f"round {rnd}")
+    if result is not None:
+        out.write(
+            f"  FINISHED: "
+            f"{'converged' if result.get('converged') else 'NOT converged'}"
+            f" in {result.get('wall_ms', 0.0):.1f} ms\n"
+        )
+    else:
+        out.write("  (running)\n")
+    alive = last.get("alive")
+    if alive:
+        out.write(
+            f"alive {alive}  converged {last.get('converged', 0)}/{alive}\n")
+    residuals = [
+        r["residual"] for r in trace
+        if isinstance(r.get("residual"), (int, float))
+        and r["residual"] == r["residual"]
+    ]
+    if residuals:
+        out.write(
+            f"residual  {sparkline(residuals)}  {residuals[-1]:.3e}\n")
+    counters = (manifest or {}).get("counters")
+    if counters:
+        out.write(
+            f"messages  sent={counters.get('sent', 0)}"
+            f" delivered={counters.get('delivered', 0)}"
+            f" dropped={counters.get('dropped', 0)}\n"
+        )
+    flags = anomaly_flags(manifest, metrics, trace)
+    # a still-running dir has no manifest by design — not an anomaly yet
+    flags = [f for f in flags if not f.startswith("run.json missing")
+             or result is not None]
+    if flags:
+        for f in flags:
+            out.write(f"! {f}\n")
+    else:
+        out.write("anomalies: none\n")
+    return result is not None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(
+            "usage: python -m gossipprotocol_tpu watch TELEMETRY_DIR "
+            "[--interval S] [--max-frames N]",
+            file=sys.stderr if not argv else sys.stdout,
+        )
+        return 0 if argv else 2
+    interval = INTERVAL_DEFAULT
+    max_frames: Optional[int] = None
+    paths: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("--interval", "--max-frames"):
+            if i + 1 >= len(argv):
+                print(f"watch: {a} needs a value", file=sys.stderr)
+                return 2
+            try:
+                if a == "--interval":
+                    interval = max(0.05, float(argv[i + 1]))
+                else:
+                    max_frames = int(argv[i + 1])
+            except ValueError:
+                print(f"watch: bad {a} {argv[i + 1]!r}", file=sys.stderr)
+                return 2
+            i += 2
+        else:
+            paths.append(a)
+            i += 1
+    if not paths:
+        print("watch: missing TELEMETRY_DIR", file=sys.stderr)
+        return 2
+    path = paths[0]
+    if not os.path.isdir(path):
+        print(f"watch: {path!r} is not a directory", file=sys.stderr)
+        return 2
+
+    out = sys.stdout
+    tty = out.isatty()
+    frames = 0
+    while True:
+        try:
+            data = load_telemetry_dir(path)
+        except ReportError:
+            data = None  # nothing written yet — keep waiting
+        if tty:
+            out.write("\x1b[2J\x1b[H")  # clear screen, home cursor
+        else:
+            out.write(f"--- frame {frames + 1} ---\n")
+        out.write(f"watch {path}  [{time.strftime('%H:%M:%S')}]\n")
+        finished = False
+        if data is None:
+            out.write("(no telemetry yet — waiting for the run to start)\n")
+        else:
+            finished = _frame(data, out)
+        out.flush()
+        frames += 1
+        if finished:
+            return 0
+        if max_frames is not None and frames >= max_frames:
+            return 0
+        time.sleep(interval)

@@ -45,6 +45,7 @@ from gossipprotocol_tpu.engine.driver import (
     RunResult,
     _drive,
     build_protocol,
+    compute_prediction,
     effective_keep_alive,
     mass_stats,
     warm_start,
@@ -322,10 +323,21 @@ def make_sharded_chunk_runner(
             all_sum=psum_all, interpret=(platform != "tpu"),
             axis_name=NODES_AXIS,
         )
-        if counter_slots is None:
-            counter_slots = cfg.resolve_chunk_rounds(
-                n, None if topo.implicit_full else int(topo.indices.size)
-            )
+    trace_fn = None
+    if tel.traces_on:
+        # same replication contract as the counters: every row component
+        # is psum/pmax-reduced inside the fn, so the buffer spec stays P()
+        from gossipprotocol_tpu.obs.trace import make_trace_fn
+
+        trace_fn = make_trace_fn(
+            topo, cfg, all_sum=psum_all,
+            all_max=lambda x: jax.lax.pmax(jnp.max(x), NODES_AXIS),
+        )
+    if (counter_fn is not None or trace_fn is not None) \
+            and counter_slots is None:
+        counter_slots = cfg.resolve_chunk_rounds(
+            n, None if topo.implicit_full else int(topo.indices.size)
+        )
 
     def chunk_local(state_l, nbrs, seed, round_limit):
         base_key = jax.random.key(seed)
@@ -405,7 +417,7 @@ def make_sharded_chunk_runner(
                 unconv = jnp.sum((~s.converged & s.alive).astype(jnp.int32))
                 return jax.lax.psum(unconv, NODES_AXIS) == 0
 
-        if counter_fn is None:
+        if counter_fn is None and trace_fn is None:
             def body(carry):
                 s, _ = carry
                 s = round_fn(s)
@@ -419,6 +431,50 @@ def make_sharded_chunk_runner(
                 cond, body, (state_l, global_done(state_l))
             )
             buf = None
+            trace_buf = None
+        elif trace_fn is not None:
+            # traces (optionally + counters): per-round side buffers in a
+            # dict carry. Every buffer row is psum/pmax-replicated by
+            # construction, and neither buffer ever feeds back into the
+            # round, so the state trajectory is bitwise the no-telemetry
+            # one (same contract as the counter-only branch below).
+            from gossipprotocol_tpu.obs.trace import NUM_TRACE_COLS
+
+            start = state_l.round
+
+            def body(carry):
+                s, _, bufs = carry
+                s2 = round_fn(s)
+                row = s.round - start
+                bufs = dict(bufs)
+                if counter_fn is not None:
+                    alive_cnt = alive_g if alive_g is not None else s.alive
+                    delta = jax.lax.psum(
+                        counter_fn(s, s2, nbrs, base_key, alive_cnt, gids),
+                        NODES_AXIS,
+                    )
+                    bufs["counters"] = jax.lax.dynamic_update_slice(
+                        bufs["counters"], delta[None, :],
+                        (row, jnp.int32(0)))
+                bufs["trace"] = jax.lax.dynamic_update_slice(
+                    bufs["trace"],
+                    trace_fn(s2).astype(jnp.float32)[None, :],
+                    (row, jnp.int32(0)))
+                return s2, global_done(s2), bufs
+
+            def cond(carry):
+                s, done, _ = carry
+                return jnp.logical_and(~done, s.round < round_limit)
+
+            bufs0 = {"trace": jnp.zeros(
+                (counter_slots, NUM_TRACE_COLS), jnp.float32)}
+            if counter_fn is not None:
+                bufs0["counters"] = jnp.zeros((counter_slots, 3), jnp.int32)
+            final, done, bufs = jax.lax.while_loop(
+                cond, body, (state_l, global_done(state_l), bufs0)
+            )
+            buf = bufs.get("counters")
+            trace_buf = bufs["trace"]
         else:
             # telemetry counters: per-round int32 deltas in a side buffer
             # (row = round − chunk start). The counter fn re-derives the
@@ -447,6 +503,7 @@ def make_sharded_chunk_runner(
             final, done, buf = jax.lax.while_loop(
                 cond, body, (state_l, global_done(state_l), buf0)
             )
+            trace_buf = None
         # replicated on-device stats: one host fetch per chunk (mirrors
         # engine.driver.chunk_stats, with psum/pmin/pmax reductions)
         stats = {
@@ -489,6 +546,8 @@ def make_sharded_chunk_runner(
             # conservation scalars: same reduction for baseline and chunk
             # (mass_stats docstring) — psum of local sums under shard_map
             stats.update(mass_stats(final, all_sum=psum_all))
+        if trace_buf is not None:
+            stats["trace"] = trace_buf  # psum/pmax-replicated per round
         return final, stats
 
     specs = _state_specs(state0)
@@ -573,6 +632,8 @@ def make_sharded_chunk_runner(
             # SGP injects mass every round by design; mass_stats returns
             # nothing for it (see engine.driver.mass_stats)
             stats_fields += ["mass_s", "mass_w"]
+    if trace_fn is not None:
+        stats_fields += ["trace"]
     stats_specs = {k: P() for k in stats_fields}
     sm = shard_map(
         chunk_local,
@@ -740,4 +801,5 @@ def run_simulation_sharded(
         return step2, st, info
 
     return _drive(topo, cfg, state, step, done_fn, compile_ms, trim=trim,
-                  rebuild=rebuild, run_topo=run_topo)
+                  rebuild=rebuild, run_topo=run_topo,
+                  prediction=compute_prediction(run_topo, cfg, tel))

@@ -571,3 +571,13 @@ def pushsum_diffusion_round(
         edge_chunks=edge_chunks,
         loss_windows=loss_windows,
     )
+
+
+def diffusion_trace_row(state, *, all_sum=sum0, all_max=jnp.max):
+    """Observatory trace row for fanout-all diffusion (and the accelerated
+    two-buffer variants): diffusion shares ``PushSumState``'s (s, w, ratio)
+    fields, so the row IS push-sum's — one definition, re-exported here so
+    the obs dispatch mirrors build_protocol branch-for-branch."""
+    from gossipprotocol_tpu.protocols.pushsum import pushsum_trace_row
+
+    return pushsum_trace_row(state, all_sum=all_sum, all_max=all_max)

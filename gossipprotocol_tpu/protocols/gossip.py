@@ -260,6 +260,31 @@ def gossip_message_counts(
     return jnp.stack([sent, delivered, dropped])
 
 
+def gossip_trace_row(state, *, all_sum=None, all_max=None) -> jax.Array:
+    """Observatory trace row for gossip (column contract in
+    :mod:`gossipprotocol_tpu.obs.trace`): the "residual" is the fraction
+    of alive nodes the rumor has not reached yet — like push-sum's
+    consensus residual it decreases toward 0 on a healthy run, so the
+    anomaly stall/divergence rules apply unchanged. Mass and train-loss
+    columns are NaN (gossip counts hits; it has no conserved quantity).
+    ``all_max`` is accepted for signature parity but unused.
+    """
+    from gossipprotocol_tpu.protocols.pushsum import sum0
+
+    del all_max
+    if all_sum is None:
+        all_sum = sum0
+    dt = jnp.float32
+    alive = state.alive
+    n_alive = jnp.maximum(all_sum(alive.astype(dt)), 1)
+    heard = all_sum(((state.counts >= 1) & alive).astype(dt))
+    frac = all_sum((state.converged & alive).astype(dt)) / n_alive
+    nan = jnp.asarray(jnp.nan, dt)
+    return jnp.stack([
+        (1 - heard / n_alive).astype(dt), frac.astype(dt), nan, nan, nan,
+    ])
+
+
 def gossip_done(state: GossipState) -> jax.Array:
     """Supervisor predicate (reference: ``counter = nodes`` in the scheduler
     actor, ``Program.fs:53``): every healthy node has converged."""

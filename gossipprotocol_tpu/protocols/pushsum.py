@@ -452,3 +452,41 @@ def pushsum_done(state: PushSumState) -> jax.Array:
 def mass(state: PushSumState):
     """(Σs, Σw) — the conservation invariant tests assert on every round."""
     return state.s.sum(), state.w.sum()
+
+
+def pushsum_trace_row(state, *, all_sum=sum0, all_max=jnp.max) -> jax.Array:
+    """Observatory trace row for any push-sum-family state (plain, accel,
+    walk, SGP — everything carrying ``s/w/ratio``); see
+    :mod:`gossipprotocol_tpu.obs.trace` for the column contract.
+
+    Reads the post-round state only, so the trajectory is untouched.
+    ``all_sum`` / ``all_max`` are the cross-shard reductions (node-axis
+    sum preserving payload dims, full max) — psum/pmax closures under
+    ``shard_map``, so every component of the row is replicated.
+    """
+    dt = jnp.float32
+    alive = state.alive
+    live = rowmask(alive, state.ratio)
+    # consensus residual against the alive-mass mean (dead rows' stranded
+    # mass is excluded, mirroring RunResult.estimate_error)
+    sw = all_sum(jnp.where(alive, state.w, 0))
+    ss = all_sum(jnp.where(live, state.s, 0))
+    mean = ss / jnp.maximum(sw, jnp.asarray(1e-30, state.w.dtype))
+    residual = all_max(jnp.where(live, jnp.abs(state.ratio - mean), 0))
+    n_alive = all_sum(alive.astype(dt))
+    frac = (all_sum((state.converged & alive).astype(dt))
+            / jnp.maximum(n_alive, 1))
+    # conservation terms over every row (stranded mass included); the
+    # walk's in-flight token carries real mass
+    ms = all_sum(state.s)
+    mw = all_sum(state.w)
+    if hasattr(state, "msg_s"):
+        ms = ms + state.msg_s
+        mw = mw + state.msg_w
+    ms = jnp.sum(ms)  # collapse [d] payload mass to one scalar
+    loss = (state.loss if hasattr(state, "loss")
+            else jnp.asarray(jnp.nan, dt))
+    return jnp.stack([
+        residual.astype(dt), frac.astype(dt), ms.astype(dt),
+        jnp.asarray(mw, dt), jnp.asarray(loss, dt),
+    ])

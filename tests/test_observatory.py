@@ -1,0 +1,776 @@
+"""Convergence-observatory tests: trace zero-cost-off/bitwise-on
+contracts, the analytic round predictor, the anomaly rule engine,
+``report --compare`` regression detection, ``watch``, and the run-history
+index.
+
+The zero-cost-off contract is pinned by *program-text goldens*: the
+lowered chunk programs with traces off (both telemetry fully off and
+counters-only) must be byte-identical to the programs the pre-trace
+engine built. The goldens are captured by running
+
+    python tests/test_observatory.py --capture
+
+against a tree WITHOUT the trace changes (or any tree believed good) and
+are compared by digest at test time. Lowered MLIR text is stable within
+a jax version but not across versions, so the golden records the jax
+version and the comparison skips on mismatch — the bitwise-on tests
+below cover those environments instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu.engine.driver import (
+    RunConfig,
+    build_protocol,
+    device_arrays,
+    make_chunk_runner,
+)
+from gossipprotocol_tpu.topology import build_topology
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "golden", "chunk_programs.json"
+)
+
+# (name, config kwargs) — one per engine branch whose trace-off program
+# must stay literally the pre-trace program
+_PROGRAM_CASES = {
+    "gossip": dict(algorithm="gossip"),
+    "pushsum_one": dict(algorithm="push-sum"),
+    "pushsum_diffusion": dict(
+        algorithm="push-sum", fanout="all", predicate="global"
+    ),
+    "sgp": dict(
+        algorithm="push-sum", workload="sgp", predicate="global",
+        payload_dim=2,
+    ),
+}
+
+
+def _make_telemetry(tmpdir, *, counters):
+    """Telemetry hub with traces OFF regardless of tree version (the
+    ``traces`` kwarg does not exist pre-change)."""
+    from gossipprotocol_tpu.obs import Telemetry
+
+    kw = {}
+    if "traces" in inspect.signature(Telemetry.__init__).parameters:
+        kw["traces"] = False
+    return Telemetry(str(tmpdir), counters=counters, **kw)
+
+
+def _single_chip_lowered(cfg_kwargs, tel) -> str:
+    cfg = RunConfig(seed=0, telemetry=tel, **cfg_kwargs)
+    topo = build_topology("line", 32)
+    state, core, done_fn, extra, (aa, ta) = build_protocol(topo, cfg)
+    nbrs = device_arrays(topo, cfg)
+    slots = cfg.resolve_chunk_rounds(32, int(topo.indices.size))
+    counter_fn = None
+    if tel is not None and tel.counters_on:
+        from gossipprotocol_tpu.obs.counters import make_counter_fn
+
+        counter_fn = make_counter_fn(
+            topo, cfg, all_alive=aa, targets_alive=ta, interpret=True
+        )
+    runner = make_chunk_runner(
+        core, done_fn, extra, counter_fn=counter_fn, counter_slots=slots
+    )
+    return runner.lower(
+        state, nbrs, jax.random.key(0), jnp.int32(0)
+    ).as_text()
+
+
+def _sharded_lowered(cfg_kwargs, tel) -> str:
+    from gossipprotocol_tpu.parallel.mesh import make_mesh
+    from gossipprotocol_tpu.parallel.sharded import make_sharded_chunk_runner
+
+    cfg = RunConfig(seed=0, telemetry=tel, **cfg_kwargs)
+    topo = build_topology("line", 32)
+    mesh = make_mesh(2, devices=jax.devices("cpu")[:2])
+    runner, state0, nbrs, _, _ = make_sharded_chunk_runner(topo, cfg, mesh)
+    return runner.lower(state0, nbrs, jnp.int32(0), jnp.int32(0)).as_text()
+
+
+def _program_digests(tmpdir) -> dict:
+    """Digest every trace-off chunk program the goldens pin."""
+    out = {}
+    for name, kwargs in _PROGRAM_CASES.items():
+        for label, tel in (
+            ("off", None),
+            ("ctr", _make_telemetry(tmpdir, counters=True)),
+        ):
+            text = _single_chip_lowered(kwargs, tel)
+            out[f"{name}_1chip_{label}"] = hashlib.sha256(
+                text.encode()
+            ).hexdigest()
+            if tel is not None:
+                tel.close()
+    for name in ("gossip", "pushsum_one"):
+        for label, mk in (
+            ("off", lambda: None),
+            ("ctr", lambda: _make_telemetry(tmpdir, counters=True)),
+        ):
+            tel = mk()
+            text = _sharded_lowered(_PROGRAM_CASES[name], tel)
+            out[f"{name}_2shard_{label}"] = hashlib.sha256(
+                text.encode()
+            ).hexdigest()
+            if tel is not None:
+                tel.close()
+    return out
+
+
+def test_trace_off_keeps_pre_change_programs(tmp_path):
+    """Zero-cost-off: with traces off (telemetry None, and counters-only)
+    every chunk program is byte-identical to the pre-trace capture —
+    single-chip and 2-shard."""
+    if not os.path.isfile(GOLDEN_PATH):
+        pytest.skip("no golden capture (run tests/test_observatory.py "
+                    "--capture on a known-good tree)")
+    with open(GOLDEN_PATH) as fh:
+        golden = json.load(fh)
+    if golden.get("jax_version") != jax.__version__:
+        pytest.skip(
+            f"golden captured on jax {golden.get('jax_version')}, running "
+            f"{jax.__version__}: lowered text is not comparable across "
+            "versions (bitwise-on tests cover this environment)"
+        )
+    got = _program_digests(tmp_path)
+    mismatched = {
+        k: (golden["digests"].get(k), v)
+        for k, v in got.items()
+        if golden["digests"].get(k) != v
+    }
+    assert not mismatched, (
+        "trace-off chunk programs changed vs the pre-trace goldens: "
+        f"{sorted(mismatched)}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# bitwise-on: traces enabled must not perturb the trajectory
+
+
+def _telemetry_on(tmpdir, *, counters=True):
+    from gossipprotocol_tpu.obs import Telemetry
+
+    return Telemetry(str(tmpdir), counters=counters, traces=True)
+
+
+# (topology args, config kwargs) — one per trace-row family; small
+# topologies keep the double-run cost down
+_BITWISE_CASES = {
+    "gossip": (("erdos_renyi", 64, 3), dict(algorithm="gossip")),
+    "diffusion": (("line", 64, None), dict(
+        algorithm="push-sum", fanout="all", predicate="global", tol=1e-3)),
+    "sgp": (("imp3D", 64, 1), dict(
+        algorithm="push-sum", workload="sgp", payload_dim=4, fanout="all",
+        predicate="global", tol=1e-3, max_rounds=3000)),
+}
+
+
+def _build(topo_args):
+    kind, n, seed = topo_args
+    return build_topology(kind, n, **({} if seed is None else {"seed": seed}))
+
+
+def _states_equal(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+        for x, y in zip(la, lb)
+    )
+
+
+@pytest.mark.parametrize("case", sorted(_BITWISE_CASES))
+def test_trace_on_bitwise_identical(case, tmp_path):
+    """Traces on vs off: identical round count and bitwise-identical final
+    state, while trace.jsonl fills with sane per-round rows."""
+    from gossipprotocol_tpu.engine import run_simulation
+    from gossipprotocol_tpu.obs.trace import load_trace
+
+    topo_args, kwargs = _BITWISE_CASES[case]
+    # trace-only on gossip exercises the counters-off trace branch
+    counters = case != "gossip"
+    tel = _telemetry_on(tmp_path / "on", counters=counters)
+    cfg_on = RunConfig(seed=7, telemetry=tel, **kwargs)
+    res_on = run_simulation(_build(topo_args), cfg_on)
+    tel.close()
+    res_off = run_simulation(
+        _build(topo_args), RunConfig(seed=7, **kwargs))
+
+    assert res_on.rounds == res_off.rounds
+    assert res_on.converged == res_off.converged
+    assert _states_equal(res_on.final_state, res_off.final_state)
+
+    rows = load_trace(str(tmp_path / "on" / "trace.jsonl"))
+    assert rows, "traces on wrote no trace.jsonl rows"
+    rounds = [r["round"] for r in rows]
+    assert rounds == sorted(rounds) and rounds[-1] <= res_on.rounds
+    assert all(0.0 <= r["converged_frac"] <= 1.0 for r in rows)
+    if case == "gossip":
+        assert "mass_s" not in rows[0]  # NaN columns are omitted
+    else:
+        # push-sum conservation terms: Σw stays ≈ n throughout
+        n = _build(topo_args).num_nodes
+        assert all(abs(r["mass_w"] - n) < 1e-2 * n for r in rows)
+        assert rows[-1]["residual"] < rows[0]["residual"]
+    if case == "sgp":
+        assert any("train_loss" in r for r in rows)
+
+
+def test_trace_on_bitwise_identical_sharded(tmp_path):
+    """Same contract under shard_map (2 CPU shards): the psum'd trace rows
+    must not perturb the sharded trajectory."""
+    from gossipprotocol_tpu.obs.trace import load_trace
+    from gossipprotocol_tpu.parallel.sharded import run_simulation_sharded
+
+    kwargs = dict(algorithm="push-sum", fanout="all", predicate="global",
+                  tol=1e-3)
+    topo_args = ("erdos_renyi", 64, 3)
+    tel = _telemetry_on(tmp_path / "on")
+    res_on = run_simulation_sharded(
+        _build(topo_args), RunConfig(seed=7, telemetry=tel, **kwargs),
+        num_devices=2)
+    tel.close()
+    res_off = run_simulation_sharded(
+        _build(topo_args), RunConfig(seed=7, **kwargs), num_devices=2)
+
+    assert res_on.rounds == res_off.rounds
+    assert _states_equal(res_on.final_state, res_off.final_state)
+    rows = load_trace(str(tmp_path / "on" / "trace.jsonl"))
+    assert rows and rows[-1]["round"] <= res_on.rounds
+    n = _build(topo_args).num_nodes
+    assert all(abs(r["mass_w"] - n) < 1e-2 * n for r in rows)
+
+
+def test_trace_writer_downsample_bound(tmp_path):
+    """R rounds through a cap-c writer: at most c·(1+log2(R/c)) lines, and
+    the kept rounds are exactly the stride-aligned ones."""
+    from gossipprotocol_tpu.obs.trace import TraceWriter, load_trace
+
+    cap, total = 16, 4096
+    path = str(tmp_path / "trace.jsonl")
+    w = TraceWriter(path, cap=cap)
+    start = 0
+    while start < total:
+        m = min(100, total - start)
+        w.add(start, np.full((m, 5), 0.5, np.float32))
+        start += m
+    w.close()
+    rows = load_trace(path)
+    bound = cap * (1 + np.log2(total / cap))
+    assert w.rows_written == len(rows) <= bound
+    assert w.last_round == total
+    # every surviving round is divisible by some historical stride >= 1;
+    # the final stride keeps the tail sparse
+    assert all(r["round"] % 1 == 0 for r in rows)
+    assert rows[-1]["round"] > total - 2 * w.stride
+
+
+# ---------------------------------------------------------------------------
+# analytic predictor
+
+
+def test_predictor_shapes_line_full():
+    """line/full × {256, 4096}: spectral γ in (0,1) for the line and
+    growing toward 1 with n (predicted rounds scale ~n²); K_n mixes in
+    one application at any size."""
+    from gossipprotocol_tpu.obs.predict import predict_rounds
+
+    cfg = RunConfig(algorithm="push-sum", fanout="all", predicate="global",
+                    tol=1e-3)
+    preds = {}
+    for kind in ("line", "full"):
+        for n in (256, 4096):
+            doc = predict_rounds(build_topology(kind, n), cfg)
+            assert doc["model"] == "spectral-pushsum"
+            assert doc["predicted_rounds"] >= 1
+            assert doc["budget_rounds"] <= cfg.max_rounds
+            preds[(kind, n)] = doc
+    for n in (256, 4096):
+        assert 0.0 < preds[("line", n)]["gamma"] < 1.0
+        # line mixing is superlinear in n (theory: ~n² — the estimator's
+        # power iteration resolves γ only to its iteration budget, so
+        # assert well-past-linear, not the exact square)
+        assert preds[("line", n)]["predicted_rounds"] > 10 * n
+    assert preds[("line", 4096)]["gamma"] > preds[("line", 256)]["gamma"]
+    assert (preds[("line", 4096)]["predicted_rounds"]
+            > preds[("line", 256)]["predicted_rounds"])
+    # K_n is analytic (γ=0): one W application + confirmation tail,
+    # independent of n
+    for n in (256, 4096):
+        assert preds[("full", n)]["gamma"] == 0.0
+        assert preds[("full", n)]["predicted_rounds"] <= 2 + cfg.streak_target
+    assert (preds[("full", 256)]["predicted_rounds"]
+            == preds[("full", 4096)]["predicted_rounds"])
+
+
+def test_predictor_gossip_heuristic():
+    from gossipprotocol_tpu.obs.predict import predict_rounds
+
+    doc = predict_rounds(build_topology("full", 256),
+                         RunConfig(algorithm="gossip"))
+    assert doc["model"] == "gossip-heuristic"
+    assert doc["confidence"] == "heuristic"
+    assert doc["gamma"] is None
+    assert doc["predicted_rounds"] >= 1
+
+
+def test_predictor_vs_actual_recorded(tmp_path):
+    """A diffusion run the spectral model covers: the actual round count
+    lands within the budget-factor constant of the prediction, the
+    manifest records both, and the report renders the comparison."""
+    import io
+
+    from gossipprotocol_tpu.engine import run_simulation
+    from gossipprotocol_tpu.obs import Telemetry, write_manifest
+    from gossipprotocol_tpu.obs.predict import BUDGET_FACTOR
+    from gossipprotocol_tpu.obs.report import load_telemetry_dir, render
+
+    topo = build_topology("line", 64)
+    tel = Telemetry(str(tmp_path), traces=True)
+    cfg = RunConfig(algorithm="push-sum", fanout="all", predicate="global",
+                    tol=1e-3, seed=0, telemetry=tel)
+    res = run_simulation(topo, cfg)
+    write_manifest(tel, cfg, topo, res)
+    tel.close()
+
+    assert res.converged
+    pred = tel.prediction
+    assert pred is not None and pred["model"] == "spectral-pushsum"
+    # within the constant factor both ways: the bound is an upper bound
+    # (actual <= factor x predicted) and not absurdly loose
+    assert res.rounds <= BUDGET_FACTOR * pred["predicted_rounds"]
+    assert pred["predicted_rounds"] <= 10 * res.rounds
+    assert pred["actual_rounds"] == res.rounds
+    assert pred["actual_over_predicted"] == pytest.approx(
+        res.rounds / pred["predicted_rounds"], abs=1e-3)
+
+    data = load_telemetry_dir(str(tmp_path))
+    assert data["manifest"]["prediction"]["predicted_rounds"] == (
+        pred["predicted_rounds"])
+    buf = io.StringIO()
+    render(data, buf)
+    text = buf.getvalue()
+    assert "prediction: spectral-pushsum" in text
+    assert f"actual {res.rounds}" in text
+    assert "anomalies: none" in text
+
+
+def test_predictor_vs_actual_full_graph(tmp_path):
+    """K_n converges essentially immediately; the γ=0 prediction agrees."""
+    from gossipprotocol_tpu.engine import run_simulation
+    from gossipprotocol_tpu.obs import Telemetry
+    from gossipprotocol_tpu.obs.predict import BUDGET_FACTOR
+
+    tel = Telemetry(str(tmp_path), traces=True)
+    cfg = RunConfig(algorithm="push-sum", predicate="global", tol=1e-3,
+                    seed=0, telemetry=tel)
+    res = run_simulation(build_topology("full", 256), cfg)
+    tel.close()
+    assert res.converged
+    pred = tel.prediction
+    assert pred is not None and pred["gamma"] == 0.0
+    assert res.rounds <= BUDGET_FACTOR * pred["predicted_rounds"]
+
+
+def test_round_budget_enforced(tmp_path):
+    """--round-budget N: the run stops at N with a structured over_budget
+    record, and the report flags it."""
+    import io
+
+    from gossipprotocol_tpu.engine import run_simulation
+    from gossipprotocol_tpu.obs import Telemetry, write_manifest
+    from gossipprotocol_tpu.obs.report import load_telemetry_dir, render
+
+    topo = build_topology("line", 64)
+    tel = Telemetry(str(tmp_path), traces=True)
+    cfg = RunConfig(algorithm="push-sum", fanout="all", predicate="global",
+                    tol=1e-3, seed=0, round_budget=40, chunk_rounds=16,
+                    telemetry=tel)
+    res = run_simulation(topo, cfg)
+    write_manifest(tel, cfg, topo, res)
+    tel.close()
+
+    assert not res.converged
+    assert res.rounds <= 48  # stops within one chunk of the budget
+    ob = [m for m in res.metrics if m.get("event") == "over_budget"]
+    assert ob and ob[-1]["budget_rounds"] == 40
+    assert ob[-1]["budget_source"] == "explicit"
+    assert tel.prediction["over_budget"] is True
+
+    data = load_telemetry_dir(str(tmp_path))
+    buf = io.StringIO()
+    render(data, buf)
+    assert "EXCEEDED round budget" in buf.getvalue()
+
+
+def test_round_budget_auto(tmp_path):
+    """--round-budget auto on a healthy run: budget derived from the
+    prediction, run converges well inside it."""
+    from gossipprotocol_tpu.engine import run_simulation
+    from gossipprotocol_tpu.obs import Telemetry
+
+    tel = Telemetry(str(tmp_path), traces=True)
+    cfg = RunConfig(algorithm="push-sum", fanout="all", predicate="global",
+                    tol=1e-3, seed=0, round_budget="auto", telemetry=tel)
+    res = run_simulation(build_topology("line", 64), cfg)
+    tel.close()
+    assert res.converged
+    assert tel.prediction["over_budget"] is False
+    assert res.rounds <= tel.prediction["budget_rounds"]
+
+
+def test_round_budget_validation():
+    with pytest.raises(ValueError):
+        RunConfig(round_budget=0)
+    with pytest.raises(ValueError):
+        RunConfig(round_budget="sometimes")
+    RunConfig(round_budget="auto")
+    RunConfig(round_budget=17)
+
+
+# ---------------------------------------------------------------------------
+# anomaly rule engine (synthetic fixtures — exact flag texts are API)
+
+
+def _mk_manifest(**over):
+    doc = {
+        "config": {"algorithm": "push-sum", "workload": "avg",
+                   "fault_schedule": {"kill_events": 0, "revive_events": 0,
+                                      "loss_windows": 0}},
+        "topology": {"kind": "line", "num_nodes": 64},
+        "result": {"converged": True, "rounds": 100, "wall_ms": 10.0},
+        "counters": {"sent": 1000, "delivered": 1000, "dropped": 0},
+        "max_mass_drift_ulps": 2.0,
+        "max_w_drift_ulps": 0.0,
+        "prediction": None,
+    }
+    doc.update(over)
+    return doc
+
+
+def _flags(manifest=None, metrics=(), trace=None, **over):
+    from gossipprotocol_tpu.obs.anomaly import anomaly_flags
+
+    m = _mk_manifest(**over) if manifest is None else manifest
+    return anomaly_flags(m, list(metrics), trace)
+
+
+def test_anomaly_clean_run_has_no_flags():
+    assert _flags() == []
+
+
+def test_anomaly_not_converged():
+    flags = _flags(result={"converged": False, "rounds": 100})
+    assert "DID NOT CONVERGE within the round budget" in flags
+
+
+def test_anomaly_gossip_stall():
+    flags = _flags(metrics=[{"round": 5, "stalled": True}])
+    assert ("gossip STALLED (live spreaders exhausted before quorum)"
+            in flags)
+
+
+def test_anomaly_w_underflow():
+    flags = _flags(metrics=[{"round": 5, "w_underflow": 3}])
+    assert ("push-sum w-underflow: up to 3 alive rows hit w == 0 "
+            "(dry-spell wall — consider f64)") in flags
+
+
+def test_anomaly_dropped_messages():
+    flags = _flags(counters={"sent": 100, "delivered": 95, "dropped": 5})
+    assert "5 messages dropped by link loss" in flags
+
+
+def test_anomaly_mass_drift():
+    flags = _flags(max_mass_drift_ulps=128.0)
+    assert ("push-sum mass drift up to 128 ULPs (large for the dtype — "
+            "check loss windows / dtype choice)") in flags
+
+
+def test_anomaly_counter_imbalance():
+    flags = _flags(counters={"sent": 100, "delivered": 90, "dropped": 0})
+    assert ("counter imbalance: sent=100 but delivered=90 + dropped=0 = 90 "
+            "(messages unaccounted for outside loss windows)") in flags
+    # gated out under churn (dead receivers legitimately ignore shares)
+    m = _mk_manifest(counters={"sent": 100, "delivered": 90, "dropped": 0})
+    m["config"]["fault_schedule"]["kill_events"] = 2
+    assert not any("counter imbalance" in f for f in _flags(manifest=m))
+    # and for gossip (receiver-side suppression is sent-not-delivered)
+    m = _mk_manifest(counters={"sent": 100, "delivered": 90, "dropped": 0})
+    m["config"]["algorithm"] = "gossip"
+    assert not any("counter imbalance" in f for f in _flags(manifest=m))
+
+
+def test_anomaly_over_budget():
+    flags = _flags(
+        result={"converged": False, "rounds": 50},
+        metrics=[{"event": "over_budget", "round": 50, "budget_rounds": 50,
+                  "budget_source": "explicit", "predicted_rounds": 10}],
+        prediction={"predicted_rounds": 10, "budget_rounds": 80,
+                    "over_budget": True, "actual_rounds": 50,
+                    "model": "spectral-pushsum", "confidence": "analytic"},
+    )
+    assert ("EXCEEDED round budget: stopped at round 50 of budget 50 "
+            "(predicted 10 rounds)") in flags
+
+
+def test_anomaly_round_blowout():
+    flags = _flags(prediction={
+        "predicted_rounds": 10, "budget_rounds": 80, "budget_factor": 8,
+        "over_budget": False, "actual_rounds": 100, "converged": True,
+        "model": "spectral-pushsum", "confidence": "analytic"})
+    assert ("round blowout: 100 rounds > 8x the analytic prediction "
+            "(10 rounds)") in flags
+
+
+def _trace_rows(residuals):
+    return [{"round": i + 1, "residual": float(v)}
+            for i, v in enumerate(residuals)]
+
+
+def test_anomaly_residual_plateau():
+    trace = _trace_rows([1.0, 0.8, 0.6] + [0.5] * 8)
+    flags = _flags(result={"converged": False, "rounds": 11}, trace=trace)
+    assert any(f.startswith("residual PLATEAU: stuck at 5.000e-01")
+               for f in flags)
+    # a converged run's flat tail is NOT a plateau anomaly
+    assert not any("PLATEAU" in f for f in _flags(trace=trace))
+
+
+def test_anomaly_residual_divergence():
+    trace = _trace_rows([0.1, 0.1, 0.12, 0.15, 0.2, 0.3, 0.5, 0.9])
+    flags = _flags(result={"converged": False, "rounds": 8}, trace=trace)
+    assert any(f.startswith("residual DIVERGING: 1.000e-01 -> 9.000e-01")
+               for f in flags)
+    assert not any("DIVERGING" in f for f in _flags(trace=trace))
+
+
+def test_anomaly_missing_manifest():
+    from gossipprotocol_tpu.obs.anomaly import anomaly_flags
+
+    flags = anomaly_flags(None, [], None)
+    assert flags == ["run.json missing: run likely crashed before finishing"]
+
+
+# ---------------------------------------------------------------------------
+# report: partial dirs, --compare
+
+
+def _write_dir(tmp, manifest=None, events=(), trace=()):
+    os.makedirs(tmp, exist_ok=True)
+    if manifest is not None:
+        with open(os.path.join(tmp, "run.json"), "w") as fh:
+            json.dump(manifest, fh)
+    if events:
+        with open(os.path.join(tmp, "events.jsonl"), "w") as fh:
+            for rec in events:
+                fh.write(json.dumps(rec) + "\n")
+    if trace:
+        with open(os.path.join(tmp, "trace.jsonl"), "w") as fh:
+            for rec in trace:
+                fh.write(json.dumps({"kind": "trace", **rec}) + "\n")
+
+
+def test_report_partial_dir_exit0(tmp_path, capsys):
+    """Events-only dir (killed run): partial report, incomplete banner,
+    exit 0 — exit 2 is reserved for truly missing/unreadable dirs."""
+    from gossipprotocol_tpu.obs.report import main as report_main
+
+    d = str(tmp_path / "partial")
+    _write_dir(d, events=[
+        {"kind": "span", "name": "chunk", "depth": 0, "dur_s": 0.5,
+         "start_s": 0.0},
+        {"kind": "metric", "rec": {"round": 10, "alive": 64, "converged": 3}},
+    ])
+    assert report_main([d]) == 0
+    out = capsys.readouterr().out
+    assert "run incomplete" in out
+    assert "run.json missing: run likely crashed before finishing" in out
+
+
+def test_report_trace_only_dir_exit0(tmp_path, capsys):
+    from gossipprotocol_tpu.obs.report import main as report_main
+
+    d = str(tmp_path / "traceonly")
+    _write_dir(d, trace=[{"round": r, "residual": 1.0 / r}
+                         for r in range(1, 20)])
+    assert report_main([d]) == 0
+    out = capsys.readouterr().out
+    assert "run incomplete" in out
+    assert "residual trace" in out
+
+
+def _finished_manifest(wall_ms=100.0, rounds=200):
+    return _mk_manifest(
+        result={"converged": True, "rounds": rounds, "wall_ms": wall_ms,
+                "compile_ms": 50.0},
+        phases={"chunk": {"count": 1, "total_s": wall_ms / 1e3}},
+        wall_s=wall_ms / 1e3,
+    )
+
+
+def test_report_compare_detects_regression(tmp_path, capsys):
+    """An injected ≥20% time-to-convergence regression exits 3; the
+    identical run exits 0."""
+    from gossipprotocol_tpu.obs.report import main as report_main
+
+    base = str(tmp_path / "base")
+    slow = str(tmp_path / "slow")
+    same = str(tmp_path / "same")
+    _write_dir(base, manifest=_finished_manifest(wall_ms=100.0))
+    _write_dir(slow, manifest=_finished_manifest(wall_ms=125.0))
+    _write_dir(same, manifest=_finished_manifest(wall_ms=101.0))
+
+    assert report_main([slow, "--compare", base]) == 3
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out
+
+    assert report_main([same, "--compare", base]) == 0
+    assert "within 20% of baseline" in capsys.readouterr().out
+
+    # flag-first operand order reads the same way
+    assert report_main(["--compare", slow, base, "--threshold", "0.2"]) == 3
+    # a looser threshold tolerates the same delta
+    assert report_main([slow, "--compare", base, "--threshold", "0.5"]) == 0
+
+
+def test_report_compare_rounds_regression(tmp_path):
+    from gossipprotocol_tpu.obs.report import main as report_main
+
+    base = str(tmp_path / "base")
+    slow = str(tmp_path / "slow")
+    _write_dir(base, manifest=_finished_manifest(rounds=100))
+    _write_dir(slow, manifest=_finished_manifest(rounds=150))
+    assert report_main([slow, "--compare", base]) == 3
+
+
+def test_report_compare_missing_baseline(tmp_path):
+    from gossipprotocol_tpu.obs.report import main as report_main
+
+    d = str(tmp_path / "run")
+    _write_dir(d, manifest=_finished_manifest())
+    assert report_main([d, "--compare", str(tmp_path / "nope")]) == 2
+    assert report_main([d, "--compare"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# watch
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_watch_subprocess_finished_run(tmp_path):
+    """watch on a finished dir renders one frame and exits 0 on its own;
+    on an empty dir it waits, frames, and honors --max-frames."""
+    import subprocess
+
+    d = str(tmp_path / "done")
+    _write_dir(d, manifest=_finished_manifest(),
+               trace=[{"round": r, "residual": 1.0 / r}
+                      for r in range(1, 10)])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "gossipprotocol_tpu", "watch", d,
+         "--interval", "0.1"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=_repo_root(),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "FINISHED: converged" in proc.stdout
+    assert "residual" in proc.stdout
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    proc = subprocess.run(
+        [sys.executable, "-m", "gossipprotocol_tpu", "watch", empty,
+         "--interval", "0.1", "--max-frames", "2"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=_repo_root(),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.count("--- frame") == 2
+    assert "no telemetry yet" in proc.stdout
+
+    assert subprocess.run(
+        [sys.executable, "-m", "gossipprotocol_tpu", "watch",
+         str(tmp_path / "missing")],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=_repo_root(),
+    ).returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# history / run index
+
+
+def test_history_index_and_deltas(tmp_path, capsys):
+    from gossipprotocol_tpu.obs.history import INDEX_RELPATH, main as history_main
+
+    root = str(tmp_path)
+    for seq, val in ((1, 10.0), (2, 12.0)):
+        with open(os.path.join(root, f"BENCH_r{seq:02d}.json"), "w") as fh:
+            json.dump({"n": seq, "rc": 0, "parsed": {
+                "metric": "demo_metric", "value": val, "unit": "s",
+                "rounds": 60 + seq, "nodes": 1000, "backend": "cpu",
+                "prediction_ratio": 1.4,
+            }}, fh)
+    run_dir = os.path.join(root, "artifacts", "bench_telemetry_r02")
+    _write_dir(run_dir, manifest={
+        "kind": "run_manifest",
+        "config": {"algorithm": "gossip"},
+        "topology": {"kind": "imp3D", "num_nodes": 1000},
+        "backend": "cpu",
+        "result": {"converged": True, "rounds": 61, "wall_ms": 12000.0},
+        "prediction": {"predicted_rounds": 44,
+                       "actual_over_predicted": 1.39},
+    })
+
+    assert history_main([root]) == 0
+    out = capsys.readouterr().out
+    assert "demo_metric" in out
+    assert "+20.0%" in out  # r02 vs r01 delta
+    assert "pred-ratio 1.40" in out
+    assert "1.39x predicted" in out
+
+    index = os.path.join(root, INDEX_RELPATH)
+    assert os.path.isfile(index)
+    with open(index) as fh:
+        recs = [json.loads(line) for line in fh]
+    assert [r["kind"] for r in recs] == ["bench", "bench", "run"]
+    assert recs[1]["value"] == 12.0
+
+    assert history_main([str(tmp_path / "nope")]) == 2
+
+
+if __name__ == "__main__":
+    if "--capture" in sys.argv:
+        import tempfile
+
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with tempfile.TemporaryDirectory() as td:
+            doc = {
+                "jax_version": jax.__version__,
+                "platform": jax.default_backend(),
+                "digests": _program_digests(td),
+            }
+        with open(GOLDEN_PATH, "w") as fh:
+            json.dump(doc, fh, indent=2)
+            fh.write("\n")
+        print(f"captured {len(doc['digests'])} digests -> {GOLDEN_PATH}")
+    else:
+        print("usage: python tests/test_observatory.py --capture")
