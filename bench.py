@@ -53,10 +53,17 @@ def _probe_backend() -> None:
         ok = False
         detail = f"device init exceeded {timeout_s:.0f}s"
     if not ok:
+        # the record must still say WHERE it died even with the worker
+        # gone: host peak RSS + the backend that was requested (the live
+        # backend is unreachable by definition here)
+        from gossipprotocol_tpu.obs.resources import host_peak_rss_bytes
+
         print(json.dumps({
             "worker_down": True,
             "probe_s": round(time.perf_counter() - t0, 2),
             "detail": detail,
+            "peak_rss_bytes": host_peak_rss_bytes(),
+            "requested_backend": os.environ.get("JAX_PLATFORMS", "auto"),
         }), flush=True)
         sys.exit(3)
 
@@ -166,6 +173,11 @@ def main():
         "compile_s": round(res.compile_ms / 1e3, 2),
         "nodes": topo.num_nodes,
         "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        # host-side peak RSS so far (headline + 1k + vector points): the
+        # topology/plan builds dominate host memory, and a creeping build
+        # footprint shows up here across BENCH_r* records
+        "peak_rss_bytes": _peak_rss(),
         "aux_1k_ms": round(res_1k.wall_ms, 2),
         "aux_1k_vs_fsharp": round(ref_1k_ms / max(res_1k.wall_ms, 1e-9), 1),
         # headline run's host-phase split (topology/protocol build, jit
@@ -206,7 +218,15 @@ def main():
         except Exception as e:  # noqa: BLE001
             aux_10m = {"aux_10M_error": f"{type(e).__name__}: {e}"[:200]}
 
+    if aux_10m:
+        aux_10m["peak_rss_bytes"] = _peak_rss()  # includes the 10M build
     print(json.dumps({**headline, **aux_10m}))
+
+
+def _peak_rss():
+    from gossipprotocol_tpu.obs.resources import host_peak_rss_bytes
+
+    return host_peak_rss_bytes()
 
 
 if __name__ == "__main__":

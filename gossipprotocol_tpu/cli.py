@@ -540,6 +540,10 @@ def main(argv=None) -> int:
         from gossipprotocol_tpu.obs.history import main as history_main
 
         return history_main(effective_argv[1:])
+    if effective_argv and effective_argv[0] == "plan":
+        from gossipprotocol_tpu.obs.capacity import main as plan_main
+
+        return plan_main(effective_argv[1:])
 
     args = build_parser().parse_args(argv)
 
@@ -715,6 +719,18 @@ def main(argv=None) -> int:
         print(str(e), file=sys.stderr)
         return 2
 
+    # capacity preflight: refuse a run whose predicted per-device footprint
+    # cannot fit before any plan build (no-op where capacity is unknown,
+    # i.e. CPU without $GOSSIP_TPU_HBM_BYTES)
+    from gossipprotocol_tpu.obs.capacity import CapacityError
+    from gossipprotocol_tpu.obs.capacity import preflight as capacity_preflight
+
+    try:
+        capacity_preflight(topo, cfg, args.devices, tel)
+    except CapacityError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
     if (args.auto_resume > 0 and not args.resume
             and not (args.checkpoint_every and args.checkpoint_dir)):
         # RunConfig warns about the half-configured pair; this is the
@@ -846,18 +862,33 @@ def main(argv=None) -> int:
         # it runs on success, on every error path below, and before the
         # recovery re-exec — the manifest is written afterwards (it only
         # reads accumulated totals, never the event stream)
-        with tel, maybe_trace(args.profile_dir):
-            if args.devices > 1:
-                from gossipprotocol_tpu.parallel import run_simulation_sharded
+        with tel:
+            if args.profile_dir and tel.enabled:
+                # recorded so report/manifest point at the profiler trace;
+                # mark_span (depth 1) keeps the phase rollup honest — a
+                # depth-0 wrapper would double-count every phase under it
+                tel.profile_dir = args.profile_dir
+            _prof_start = tel.wall_s()
+            with maybe_trace(args.profile_dir):
+                if args.devices > 1:
+                    from gossipprotocol_tpu.parallel import (
+                        run_simulation_sharded,
+                    )
 
-                result = run_simulation_sharded(
-                    topo, cfg, num_devices=args.devices, initial_state=state,
-                    backend=None if args.backend == "auto" else args.backend,
-                )
-            elif state is not None:
-                result = resume_simulation(topo, cfg, state)
-            else:
-                result = run_simulation(topo, cfg)
+                    result = run_simulation_sharded(
+                        topo, cfg, num_devices=args.devices,
+                        initial_state=state,
+                        backend=(None if args.backend == "auto"
+                                 else args.backend),
+                    )
+                elif state is not None:
+                    result = resume_simulation(topo, cfg, state)
+                else:
+                    result = run_simulation(topo, cfg)
+            if args.profile_dir:
+                tel.mark_span("profiler_trace", _prof_start,
+                              tel.wall_s() - _prof_start,
+                              trace_dir=args.profile_dir)
     except Exception as e:
         # routed-delivery build rejections are user input errors that can
         # only surface once the plan compiler sees the graph — same

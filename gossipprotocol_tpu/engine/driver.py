@@ -1408,6 +1408,7 @@ def _drive(
         cur_round = int(host.pop("round"))
         done = bool(host.pop("done"))
         counters = host.pop("counters", None)
+        shard_counters = host.pop("shard_counters", None)
         trace_buf = host.pop("trace", None)
         chunk_mass = (host.pop("mass_s", None), host.pop("mass_w", None))
         if trace_buf is not None and cur_round > chunk_start:
@@ -1425,6 +1426,23 @@ def _drive(
             rec["delivered"] = delivered
             rec["dropped"] = dropped
             tel.add_counters(sent, delivered, dropped)
+            if shard_counters is not None:
+                # per-shard attribution: the unreduced partials, gathered
+                # as [num_shards * slots, 3]. Their sum over shards must
+                # reproduce the psum'd totals *bitwise* — int32 addition
+                # is exact, so any mismatch means the attribution buffer
+                # diverged from the reduced one
+                sc = np.asarray(shard_counters, np.int64)
+                per_shard = sc.reshape(-1, counters.shape[0], 3).sum(axis=1)
+                total = per_shard.sum(axis=0)
+                if (total != np.asarray([sent, delivered, dropped])).any():
+                    raise AssertionError(
+                        f"per-shard counter partials do not sum to the "
+                        f"reduced totals: {per_shard.tolist()} -> "
+                        f"{total.tolist()} != "
+                        f"{[sent, delivered, dropped]} (round={cur_round})"
+                    )
+                tel.add_shard_counters(per_shard)
         if chunk_mass[0] is not None and mass_base is not None:
             s_ulps = ulp_drift(chunk_mass[0], mass_base[0])
             w_ulps = ulp_drift(chunk_mass[1], mass_base[1])
@@ -1598,6 +1616,7 @@ def run_simulation(
     t0 = time.perf_counter()
     with tel.span("jit_compile", engine="single-chip"):
         compiled = runner.lower(state, nbrs, base_key, jnp.int32(0)).compile()
+    tel.record_compiled("chunk", compiled, engine="single-chip")
 
     def step(s, round_limit):
         return compiled(s, nbrs, base_key, jnp.int32(round_limit))
@@ -1626,6 +1645,7 @@ def run_simulation(
             trace_slots=counter_slots,
         )
         compiled2 = runner2.lower(st, nbrs2, base_key, jnp.int32(0)).compile()
+        tel.record_compiled("chunk_rebuild", compiled2, engine="single-chip")
 
         def step2(s, round_limit):
             return compiled2(s, nbrs2, base_key, jnp.int32(round_limit))

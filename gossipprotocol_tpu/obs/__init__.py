@@ -18,7 +18,16 @@ self-describing:
   config, versions, digests, resume lineage, and per-phase wall-time
   rollup that makes any BENCH/MULTICHIP number reproducible;
 * :mod:`~gossipprotocol_tpu.obs.report` — ``python -m gossipprotocol_tpu
-  report DIR`` renders a telemetry dir for humans.
+  report DIR`` renders a telemetry dir for humans;
+* :mod:`~gossipprotocol_tpu.obs.resources` — the resource observatory:
+  XLA ``cost_analysis()``/``memory_analysis()`` per compiled chunk
+  program, host-RSS/device-memory samples at span boundaries, per-shard
+  counter attribution (``shard_balance``) — persisted as
+  ``resources.json`` beside the manifest;
+* :mod:`~gossipprotocol_tpu.obs.capacity` — the analytic HBM capacity
+  planner behind the ``plan`` subcommand and the CLI's over-capacity
+  preflight (refuse before any plan build), validated against
+  ``memory_analysis()``.
 
 Zero-cost contract: with ``RunConfig.telemetry`` unset every engine code
 path sees :class:`NullTelemetry` (no-op spans, ``counters_on=False``), so

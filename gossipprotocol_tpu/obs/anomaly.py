@@ -36,6 +36,13 @@ DIVERGE_FACTOR = 2.0
 # mass drift beyond this many ULPs is flagged (matches the driver's own
 # loss-window bookkeeping slack)
 DRIFT_ULP_TOL = 64.0
+# shard attribution: max/mean sent skew beyond this factor is flagged —
+# a balanced partition sits near 1.0, and padding rows send nothing, so
+# a sustained 1.5x means one shard owns disproportionate edge work
+SHARD_SKEW_FACTOR = 1.5
+# ... but only once enough messages flowed for the ratio to mean
+# anything (tiny smoke runs legitimately skew on integer granularity)
+SHARD_SKEW_MIN_SENT = 10_000
 
 
 def _finite(x: Any) -> bool:
@@ -94,6 +101,28 @@ def _counter_flags(manifest: Optional[Dict[str, Any]]) -> List[str]:
             f"counter imbalance: sent={sent} but delivered={delivered} + "
             f"dropped={dropped} = {delivered + dropped} "
             "(messages unaccounted for outside loss windows)"
+        ]
+    return []
+
+
+def _shard_flags(manifest: Optional[Dict[str, Any]]) -> List[str]:
+    """Per-device attribution rule: a multi-shard run whose max/mean sent
+    skew exceeds :data:`SHARD_SKEW_FACTOR` has an unbalanced partition.
+    Silent on single-device runs (no ``shard_balance`` block), on runs
+    below :data:`SHARD_SKEW_MIN_SENT` total messages, and with
+    attribution off — so healthy smokes stay ``anomalies: none``."""
+    balance = (manifest or {}).get("shard_balance")
+    if not balance or balance.get("num_shards", 0) < 2:
+        return []
+    skew = balance.get("sent_skew_max_over_mean")
+    total_sent = sum(balance.get("sent") or [])
+    if not _finite(skew) or total_sent < SHARD_SKEW_MIN_SENT:
+        return []
+    if skew > SHARD_SKEW_FACTOR:
+        return [
+            f"shard imbalance: max/mean sent skew {skew:.2f}x across "
+            f"{balance['num_shards']} shards (> {SHARD_SKEW_FACTOR}x — "
+            "one shard owns disproportionate edge work)"
         ]
     return []
 
@@ -176,6 +205,7 @@ def anomaly_flags(
     """
     flags = _record_flags(manifest, metrics)
     flags += _counter_flags(manifest)
+    flags += _shard_flags(manifest)
     flags += _budget_flags(manifest, metrics)
     flags += _trace_flags(manifest, trace)
     if manifest is None:

@@ -1,0 +1,480 @@
+"""Analytic HBM capacity planner: will (n, topology, shards) fit at all?
+
+The memory-side twin of :mod:`gossipprotocol_tpu.obs.predict`: where that
+module predicts *rounds* from spectral geometry, this one predicts
+*per-device bytes* from plan geometry — state rows, delivery tables,
+edge-share temporaries, telemetry buffers — **before any plan build**,
+so an over-capacity 100M/1B request is refused in milliseconds instead
+of dying mid-build with an opaque allocator error (cf. the MULTICHIP r5
+rc=124 tail).
+
+Model structure:
+
+* **state** — measured, not modeled: the protocol state pytree is built
+  once at a tiny probe size with the *same* config knobs (algorithm,
+  payload dim, dtype, workload) and its exact bytes/row scale linearly
+  to any n. Immune to layout drift in ``protocols/state.py``.
+* **delivery** — analytic per-path formulas mirroring
+  ``engine.driver.device_arrays`` / the sharded dispatch: dense table
+  vs CSR for fanout-one sampling, edge lists for diffusion,
+  ~:data:`ROUTED_BYTES_PER_EDGE` B/directed edge for routed plans
+  (the ``ops/sharddelivery.py`` figure), all divided by the shard count
+  where the real arrays shard.
+* **edges** — closed-form per topology family (``line`` 2(n−1), grids
+  ~6n/7n, ER ``avg_degree·n``, …) so planning 1B nodes never builds a
+  1B-node graph; exact counts are passed in when a topology exists.
+
+Validation: the predicted argument bytes track XLA ``memory_analysis()``
+within a pinned tolerance on small configs (``tests/test_resources.py``).
+
+Device capacity comes from ``$GOSSIP_TPU_HBM_BYTES`` (override / CI) or
+``device.memory_stats()['bytes_limit']``; CPU backends expose neither,
+so the preflight is a no-op there unless the env var is set.
+
+``python -m gossipprotocol_tpu plan N TOPOLOGY [ALGO] [flags]`` renders
+the breakdown, predicts the max feasible n at the same geometry, and
+exits 1 for an over-capacity request — the admission-control hook.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["CapacityError", "edges_estimate", "estimate_run_bytes",
+           "estimate_for_topology", "device_capacity_bytes",
+           "max_feasible_nodes", "preflight", "main"]
+
+
+class CapacityError(ValueError):
+    """A requested run cannot fit in device memory."""
+
+
+# measured routed-plan footprint (ops/sharddelivery.py docstring):
+# ~86 bytes per directed edge across plan_in/m/out + class tables
+ROUTED_BYTES_PER_EDGE = 86
+# refuse runs predicted above this fraction of per-device capacity —
+# XLA needs allocator headroom beyond the model's accounted buffers
+DEFAULT_SAFETY = 0.9
+# probe size for the measured state bytes/row (any small multiple of
+# every supported shard count; the probe build costs ~ms)
+_PROBE_ROWS = 512
+
+_state_probe_cache: Dict[Tuple, Tuple[float, int]] = {}
+
+
+def _dtype_bytes(cfg) -> int:
+    import jax.numpy as jnp
+
+    return int(jnp.dtype(cfg.dtype).itemsize)
+
+
+def edges_estimate(kind: str, num_nodes: int, *, avg_degree: float = 8.0,
+                   m: int = 4, k: int = 6) -> Tuple[int, int]:
+    """(directed edge count, max-degree estimate) for a topology family,
+    closed-form — no graph build. The implicit complete graph has no
+    materialized edges at all (its delivery is arithmetic)."""
+    from gossipprotocol_tpu.topology.registry import canonical_name
+
+    n = int(num_nodes)
+    kind = canonical_name(kind)
+    if kind == "line":
+        return max(0, 2 * (n - 1)), 2
+    if kind == "full":
+        return 0, 0  # implicit: no edge arrays, no sampling table
+    if kind == "3D":
+        return 6 * n, 6
+    if kind == "imp3D":
+        return 7 * n, 8  # 3D lattice + one imperfect extra per node
+    if kind == "erdos_renyi":
+        # max degree: Poisson tail bound, generous enough for dense/CSR
+        # dispatch at the default avg_degree=8
+        return int(avg_degree * n), int(avg_degree + 6 * math.sqrt(avg_degree) + 4)
+    if kind == "power_law":
+        return 2 * m * n, int(math.sqrt(max(n, 1)) + 2 * m)  # hub-bound
+    if kind == "small_world":
+        return k * n, k + 8
+    raise CapacityError(f"no edge model for topology {kind!r}")
+
+
+def _state_row_bytes(cfg) -> Tuple[float, int]:
+    """(bytes per state row, fixed bytes) measured from a probe build of
+    the actual protocol state pytree with this config's knobs."""
+    import dataclasses
+
+    key = (cfg.algorithm, cfg.workload, int(cfg.payload_dim),
+           str(cfg.dtype), cfg.fanout, cfg.predicate)
+    hit = _state_probe_cache.get(key)
+    if hit is not None:
+        return hit
+    from gossipprotocol_tpu.engine.driver import build_protocol
+    from gossipprotocol_tpu.topology import build_topology
+
+    import jax
+
+    probe_cfg = dataclasses.replace(cfg, telemetry=None, seed=0)
+    topo = build_topology("line", _PROBE_ROWS)
+    state, *_ = build_protocol(topo, probe_cfg, num_rows=_PROBE_ROWS)
+    row = 0.0
+    fixed = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == _PROBE_ROWS:
+            row += leaf.nbytes / _PROBE_ROWS
+        else:
+            fixed += int(getattr(leaf, "nbytes", 0))
+    _state_probe_cache[key] = (row, fixed)
+    return row, fixed
+
+
+def _delivery_bytes(cfg, n_pad: int, local_rows: int, num_shards: int,
+                    num_edges: int, max_degree: int,
+                    implicit_full: bool) -> Tuple[int, str]:
+    """Per-device delivery-table bytes + which path was modeled.
+
+    Mirrors ``engine.driver.device_arrays`` and the sharded dispatch in
+    ``parallel/sharded.py`` — when those grow a new path, grow this.
+    """
+    from gossipprotocol_tpu.protocols.sampling import DENSE_MAX_DEGREE
+
+    if implicit_full:
+        return 0, "implicit-full"
+    is_pushsum = cfg.algorithm != "gossip"
+    e_local = -(-num_edges // num_shards)  # ceil: padded per-shard blocks
+    if is_pushsum and cfg.fanout == "all":
+        if cfg.delivery == "routed":
+            # routed plans: ~86 B/edge of tables per device (push design
+            # owns E/S edges; single-chip owns them all) + the f32
+            # exchange slab [num_shards, 2·block_pairs]
+            slab = 4 * num_edges if num_shards > 1 else 0
+            return ROUTED_BYTES_PER_EDGE * e_local + slab, "routed"
+        # diffusion edge list: src+dst int32 per edge (+ valid byte when
+        # sharded blocks carry padding) + row-aligned degree
+        per_edge = 8 + (1 if num_shards > 1 else 0)
+        return per_edge * e_local + 4 * local_rows, "diffusion-edges"
+    # fanout-one sampling (and gossip): dense row table when the max
+    # degree is bounded, else the replicated CSR pool
+    if max_degree <= DENSE_MAX_DEGREE and os.environ.get(
+            "GOSSIP_TPU_DENSE", "1") != "0":
+        return 4 * local_rows * (max_degree + 1), "dense-table"
+    # CSRNeighbors replicates on every device: starts/degree [n] +
+    # indices [E], all int32
+    return 4 * (2 * n_pad + num_edges), "csr-replicated"
+
+
+def estimate_run_bytes(
+    kind: str,
+    num_nodes: int,
+    cfg,
+    num_devices: int = 1,
+    *,
+    num_edges: Optional[int] = None,
+    max_degree: Optional[int] = None,
+    implicit_full: Optional[bool] = None,
+    telemetry_on: bool = True,
+    avg_degree: float = 8.0,
+    m: int = 4,
+    k: int = 6,
+) -> Dict[str, Any]:
+    """Predicted per-device footprint for a (topology, n, config, shards)
+    request. Pass exact ``num_edges``/``max_degree`` when a topology
+    exists; otherwise the family's closed-form estimate is used."""
+    from gossipprotocol_tpu.parallel.mesh import padded_size
+    from gossipprotocol_tpu.topology.registry import canonical_name
+
+    n = int(num_nodes)
+    if n < 1:
+        raise CapacityError(f"num_nodes must be >= 1, got {n}")
+    num_shards = max(1, int(num_devices))
+    if implicit_full is None:
+        implicit_full = canonical_name(kind) == "full"
+    if num_edges is None or max_degree is None:
+        e_est, d_est = edges_estimate(
+            kind, n, avg_degree=avg_degree, m=m, k=k)
+        num_edges = e_est if num_edges is None else int(num_edges)
+        max_degree = d_est if max_degree is None else int(max_degree)
+    n_pad = padded_size(n, num_shards) if num_shards > 1 else n
+    local_rows = n_pad // num_shards
+    B = _dtype_bytes(cfg)
+    d = int(cfg.payload_dim)
+
+    row_bytes, fixed_bytes = _state_row_bytes(cfg)
+    state_bytes = int(row_bytes * local_rows) + fixed_bytes
+
+    delivery_bytes, path = _delivery_bytes(
+        cfg, n_pad, local_rows, num_shards, num_edges, max_degree,
+        implicit_full)
+
+    # SGP data shards row-wise with the state: A [rows, samples, d] +
+    # b [rows, samples]
+    data_bytes = 0
+    if cfg.workload == "sgp":
+        data_bytes = local_rows * int(cfg.sgp_samples) * (d + 1) * B
+
+    # transient estimate: the delivery scratch XLA materializes inside a
+    # round (segment_sum accumulators / edge-share vectors), the piece
+    # memory_analysis reports as temp. Doubled for double buffering.
+    e_local = -(-num_edges // num_shards)
+    if implicit_full:
+        temp_bytes = 2 * local_rows * (d + 1) * B
+    elif cfg.algorithm != "gossip" and cfg.fanout == "all":
+        per_round_edges = -(-e_local // max(1, int(cfg.edge_chunks)))
+        temp_bytes = 2 * per_round_edges * (d + 1) * B + \
+            2 * n_pad * (d + 1) * B // num_shards
+    else:
+        temp_bytes = 2 * n_pad * (d + 1) * B // num_shards
+
+    telemetry_bytes = 0
+    if telemetry_on:
+        slots = cfg.resolve_chunk_rounds(
+            n, None if implicit_full else num_edges)
+        # counters [slots,3] i32 + shard partials + trace [slots,5] f32
+        telemetry_bytes = slots * (12 + 12 + 20)
+
+    argument_bytes = state_bytes + delivery_bytes + data_bytes + 16
+    total = argument_bytes + temp_bytes + telemetry_bytes
+    return {
+        "kind": canonical_name(kind),
+        "num_nodes": n,
+        "num_padded": n_pad,
+        "num_devices": num_shards,
+        "num_edges": int(num_edges),
+        "delivery_path": path,
+        "dtype_bytes": B,
+        "payload_dim": d,
+        "per_device": {
+            "state_bytes": state_bytes,
+            "delivery_bytes": int(delivery_bytes),
+            "data_bytes": int(data_bytes),
+            "temp_bytes": int(temp_bytes),
+            "telemetry_bytes": int(telemetry_bytes),
+            "total_bytes": int(total),
+        },
+        "argument_bytes": int(argument_bytes),
+    }
+
+
+def estimate_for_topology(topo, cfg, num_devices: int = 1,
+                          telemetry_on: bool = True) -> Dict[str, Any]:
+    """Exact-geometry variant for an already-built topology."""
+    max_deg = int(topo.degree.max()) if topo.degree.size else 0
+    return estimate_run_bytes(
+        topo.kind, topo.num_nodes, cfg, num_devices,
+        num_edges=int(topo.num_directed_edges), max_degree=max_deg,
+        implicit_full=bool(topo.implicit_full), telemetry_on=telemetry_on,
+    )
+
+
+def device_capacity_bytes() -> Tuple[Optional[int], str]:
+    """(per-device byte capacity, source). ``$GOSSIP_TPU_HBM_BYTES``
+    wins (explicit admission-control budget); else the first device's
+    ``memory_stats()['bytes_limit']``; else (None, 'unknown') — CPU
+    backends have no accounting, and the preflight stays silent there."""
+    env = os.environ.get("GOSSIP_TPU_HBM_BYTES")
+    if env:
+        try:
+            return int(float(env)), "$GOSSIP_TPU_HBM_BYTES"
+        except ValueError:
+            raise CapacityError(
+                f"bad $GOSSIP_TPU_HBM_BYTES {env!r} (want bytes)")
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"]), "memory_stats"
+    except Exception:
+        pass
+    return None, "unknown"
+
+
+def max_feasible_nodes(kind: str, cfg, num_devices: int,
+                       capacity: int, *, safety: float = DEFAULT_SAFETY,
+                       **topo_params) -> int:
+    """Largest n whose predicted per-device total fits ``safety ×
+    capacity`` at this geometry (binary search over the monotone model)."""
+    budget = safety * capacity
+
+    def fits(n: int) -> bool:
+        doc = estimate_run_bytes(kind, n, cfg, num_devices, **topo_params)
+        return doc["per_device"]["total_bytes"] <= budget
+
+    lo = 1
+    if not fits(lo):
+        return 0
+    hi = 2
+    while fits(hi) and hi < 2 ** 40:
+        lo, hi = hi, hi * 2
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if fits(mid):
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+def preflight(topo, cfg, num_devices: int = 1, tel=None) -> Optional[Dict[str, Any]]:
+    """Refuse an over-capacity run before any plan build.
+
+    Returns the estimate doc (annotated with capacity) when capacity is
+    known, None when it is not (CPU without the env override). Raises
+    :class:`CapacityError` when the prediction exceeds the safety budget.
+    """
+    capacity, source = device_capacity_bytes()
+    if capacity is None:
+        return None
+    doc = estimate_for_topology(topo, cfg, num_devices)
+    doc["capacity_bytes"] = capacity
+    doc["capacity_source"] = source
+    total = doc["per_device"]["total_bytes"]
+    doc["capacity_fraction"] = round(total / capacity, 4)
+    if tel is not None:
+        tel.note_resource("capacity_plan", doc)
+    if total > DEFAULT_SAFETY * capacity:
+        feasible = max_feasible_nodes(
+            topo.kind, cfg, num_devices, capacity,
+        )
+        raise CapacityError(
+            f"predicted per-device footprint {_fmt(total)} exceeds "
+            f"{int(DEFAULT_SAFETY * 100)}% of device capacity "
+            f"{_fmt(capacity)} ({source}) for {topo.kind}-{topo.num_nodes} "
+            f"on {num_devices} device(s); max feasible n at this geometry "
+            f"is ~{feasible} (add devices, shrink --payload-dim, or raise "
+            f"$GOSSIP_TPU_HBM_BYTES if the budget is wrong)"
+        )
+    return doc
+
+
+def _fmt(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return "?"
+
+
+def main(argv=None) -> int:
+    """``python -m gossipprotocol_tpu plan N TOPOLOGY [ALGO] [flags]``.
+
+    Exit 0 when the request fits (or capacity is unknown and no budget
+    was given), 1 when it is over capacity, 2 on bad input.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m gossipprotocol_tpu plan",
+        description="Predict per-device HBM footprint and feasibility "
+                    "before building anything.",
+    )
+    parser.add_argument("num_nodes", type=int)
+    parser.add_argument("topology")
+    parser.add_argument("algorithm", nargs="?", default="push-sum",
+                        choices=["gossip", "push-sum"])
+    parser.add_argument("--devices", type=int, default=1)
+    parser.add_argument("--fanout", choices=["one", "all"], default="one")
+    parser.add_argument("--delivery", default=None,
+                        choices=["scatter", "invert", "routed"])
+    parser.add_argument("--payload-dim", type=int, default=1)
+    parser.add_argument("--workload", choices=["avg", "sgp"], default="avg")
+    parser.add_argument("--sgp-samples", type=int, default=16)
+    parser.add_argument("--x64", action="store_true")
+    parser.add_argument("--avg-degree", type=float, default=8.0)
+    parser.add_argument("--hbm-bytes", type=float, default=None,
+                        help="override per-device capacity (bytes)")
+    parser.add_argument("--safety", type=float, default=DEFAULT_SAFETY)
+    parser.add_argument("--json", action="store_true",
+                        help="emit the raw estimate document")
+    try:
+        args = parser.parse_args(list(sys.argv[1:] if argv is None else argv))
+    except SystemExit as e:
+        return int(e.code or 0)
+    if args.num_nodes < 1 or args.devices < 1:
+        print("plan: num_nodes and --devices must be >= 1", file=sys.stderr)
+        return 2
+
+    import jax.numpy as jnp
+
+    from gossipprotocol_tpu.engine.driver import RunConfig
+
+    try:
+        cfg_kw: Dict[str, Any] = dict(
+            algorithm=args.algorithm, fanout=args.fanout,
+            payload_dim=args.payload_dim, workload=args.workload,
+            sgp_samples=args.sgp_samples,
+            dtype=jnp.float64 if args.x64 else jnp.float32,
+        )
+        if args.workload == "sgp":
+            cfg_kw.update(fanout="all", predicate="global")
+        if args.delivery is not None:
+            cfg_kw["delivery"] = args.delivery
+        elif args.fanout == "all":
+            cfg_kw["delivery"] = "routed"
+        cfg = RunConfig(**cfg_kw)
+        doc = estimate_run_bytes(
+            args.topology, args.num_nodes, cfg, args.devices,
+            avg_degree=args.avg_degree,
+        )
+    except (ValueError, CapacityError) as e:
+        print(f"plan: {e}", file=sys.stderr)
+        return 2
+
+    if args.hbm_bytes is not None:
+        capacity: Optional[int] = int(args.hbm_bytes)
+        source = "--hbm-bytes"
+    else:
+        capacity, source = device_capacity_bytes()
+
+    total = doc["per_device"]["total_bytes"]
+    over = capacity is not None and total > args.safety * capacity
+    if args.json:
+        # pure JSON on stdout (pipeable into jq): the verdict rides in
+        # the document and the exit code, never as trailing text
+        import json as _json
+
+        doc["capacity_bytes"] = capacity
+        doc["capacity_source"] = source
+        doc["safety"] = args.safety
+        if capacity is not None:
+            doc["capacity_fraction"] = round(total / capacity, 4)
+            doc["max_feasible_nodes"] = max_feasible_nodes(
+                args.topology, cfg, args.devices, capacity,
+                safety=args.safety, avg_degree=args.avg_degree)
+        doc["verdict"] = ("unknown" if capacity is None
+                         else "over_capacity" if over else "fits")
+        print(_json.dumps(doc, indent=2))
+        return 1 if over else 0
+    else:
+        per = doc["per_device"]
+        print(f"capacity plan: {args.algorithm} on "
+              f"{doc['kind']}-{doc['num_nodes']}, "
+              f"{doc['num_devices']} device(s), "
+              f"delivery={doc['delivery_path']}, "
+              f"d={doc['payload_dim']} x {doc['dtype_bytes']} B")
+        print(f"  state:        {_fmt(per['state_bytes']):>12}/device")
+        print(f"  delivery:     {_fmt(per['delivery_bytes']):>12}/device")
+        if per["data_bytes"]:
+            print(f"  workload data:{_fmt(per['data_bytes']):>12}/device")
+        print(f"  temp (est):   {_fmt(per['temp_bytes']):>12}/device")
+        print(f"  telemetry:    {_fmt(per['telemetry_bytes']):>12}/device")
+        print(f"  total:        {_fmt(per['total_bytes']):>12}/device"
+              f"  (argument bytes {_fmt(doc['argument_bytes'])})")
+
+    if capacity is None:
+        print("  capacity:     unknown (no device memory accounting on "
+              "this backend; pass --hbm-bytes or set $GOSSIP_TPU_HBM_BYTES)")
+        return 0
+    frac = total / capacity
+    feasible = max_feasible_nodes(
+        args.topology, cfg, args.devices, capacity, safety=args.safety,
+        avg_degree=args.avg_degree)
+    print(f"  capacity:     {_fmt(capacity)}/device ({source}), "
+          f"safety {args.safety:.0%}")
+    print(f"  max feasible n at this geometry: ~{feasible}")
+    if over:
+        print(f"  verdict: OVER CAPACITY ({frac:.0%} of device memory "
+              f"> {args.safety:.0%} safety budget)")
+        return 1
+    print(f"  verdict: fits ({frac:.1%} of device memory)")
+    return 0

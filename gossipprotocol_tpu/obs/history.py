@@ -87,7 +87,7 @@ def _manifest_records(root: str) -> List[Dict[str, Any]]:
             topo = doc.get("topology") or {}
             result = doc.get("result") or {}
             pred = doc.get("prediction") or {}
-            out.append({
+            rec = {
                 "v": SCHEMA_VERSION,
                 "kind": "run",
                 "source": os.path.relpath(path, root),
@@ -100,7 +100,34 @@ def _manifest_records(root: str) -> List[Dict[str, Any]]:
                 "wall_ms": result.get("wall_ms"),
                 "predicted_rounds": pred.get("predicted_rounds"),
                 "actual_over_predicted": pred.get("actual_over_predicted"),
-            })
+            }
+            rec.update(_resource_metrics(os.path.dirname(path)))
+            out.append(rec)
+    return out
+
+
+def _resource_metrics(tel_dir: str) -> Dict[str, Any]:
+    """Headline resource figures from a sibling ``resources.json``
+    (resource observatory): peak host RSS and the chunk program's
+    FLOPs / per-device argument bytes. Empty when the dir predates the
+    observatory — old records index unchanged."""
+    doc = _load_json(os.path.join(tel_dir, "resources.json"))
+    if not doc or doc.get("kind") != "run_resources":
+        return {}
+    out: Dict[str, Any] = {}
+    peak = (doc.get("host") or {}).get("peak_rss_bytes")
+    if peak is not None:
+        out["peak_rss_bytes"] = peak
+    for prog in doc.get("programs") or []:
+        if prog.get("label") != "chunk":
+            continue
+        flops = (prog.get("cost") or {}).get("flops")
+        if flops is not None:
+            out["chunk_flops"] = flops
+        arg = (prog.get("memory") or {}).get("argument_size_in_bytes")
+        if arg is not None:
+            out["chunk_argument_bytes"] = arg
+        break
     return out
 
 
@@ -168,6 +195,8 @@ def render_history(records: List[Dict[str, Any]], out: TextIO,
                 line += f", {r['wall_ms']:.1f} ms"
             if r.get("actual_over_predicted") is not None:
                 line += f", {r['actual_over_predicted']:.2f}x predicted"
+            if isinstance(r.get("peak_rss_bytes"), (int, float)):
+                line += f", peak RSS {r['peak_rss_bytes'] / 2**20:.0f} MiB"
             line += f"  ({r['source']})"
             out.write(line + "\n")
 

@@ -106,6 +106,17 @@ def build_manifest(
         # trace.jsonl bookkeeping (rows written, final stride, cap)
         "trace": (tel.trace_summary()
                   if hasattr(tel, "trace_summary") else None),
+        # per-shard counter attribution (resource observatory): per-shard
+        # sent/delivered/dropped totals + skew; None off / single-device
+        "shard_balance": (tel.shard_balance()
+                          if hasattr(tel, "shard_balance") else None),
+        # jax.profiler trace dir when the run was profiled
+        "profile_dir": getattr(tel, "profile_dir", None),
+        # sibling resources.json (compiled-program cost/memory analysis,
+        # RSS/device-memory samples) when the resource observatory is on
+        "resources": ("resources.json"
+                      if getattr(tel, "resources_on", False)
+                      and tel.dir is not None else None),
     }
     if result is not None:
         err = result.estimate_error

@@ -32,6 +32,7 @@ import sys
 from typing import Any, Dict, List, Optional, TextIO, Tuple
 
 from gossipprotocol_tpu.obs.anomaly import anomaly_flags  # re-export
+from gossipprotocol_tpu.obs.resources import load_resources
 from gossipprotocol_tpu.obs.trace import load_trace
 from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
 
@@ -83,12 +84,14 @@ def load_telemetry_dir(path: str) -> Dict[str, Any]:
                     _check_version(rec, epath)
                 events.append(rec)
     trace = load_trace(os.path.join(path, "trace.jsonl"))
-    if manifest is None and not events and not trace:
+    resources = load_resources(path)
+    if manifest is None and not events and not trace and resources is None:
         raise ReportError(
             f"no telemetry found under {path!r} (expected run.json and/or "
             "events.jsonl — was the run launched with --telemetry-dir?)"
         )
-    return {"manifest": manifest, "events": events, "trace": trace}
+    return {"manifest": manifest, "events": events, "trace": trace,
+            "resources": resources}
 
 
 def sparkline(values: List[float], width: int = 40) -> str:
@@ -133,6 +136,71 @@ def _wall_from_events(events: List[Dict[str, Any]]) -> Optional[float]:
 
 def _metric_recs(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return [r["rec"] for r in events if r.get("kind") == "metric" and "rec" in r]
+
+
+def _fmt_bytes(n: Any) -> str:
+    if not isinstance(n, (int, float)):
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return "?"
+
+
+def _render_resources(data: Dict[str, Any], manifest, out: TextIO) -> None:
+    res = data.get("resources")
+    balance = (manifest or {}).get("shard_balance")
+    if not res and not balance:
+        return
+    out.write("\nresources:\n")
+    if res:
+        host = res.get("host") or {}
+        if host.get("peak_rss_bytes") is not None:
+            out.write(
+                f"  host RSS: {_fmt_bytes(host.get('rss_bytes'))} current, "
+                f"{_fmt_bytes(host['peak_rss_bytes'])} peak\n")
+        for prog in res.get("programs") or []:
+            cost = prog.get("cost") or {}
+            mem = prog.get("memory") or {}
+            parts = []
+            if cost.get("flops") is not None:
+                parts.append(f"{cost['flops']:.3e} flops")
+            if cost.get("bytes accessed") is not None:
+                parts.append(f"{_fmt_bytes(cost['bytes accessed'])} accessed")
+            if mem.get("argument_size_in_bytes") is not None:
+                parts.append(
+                    f"args {_fmt_bytes(mem['argument_size_in_bytes'])}")
+            if mem.get("temp_size_in_bytes") is not None:
+                parts.append(
+                    f"temp {_fmt_bytes(mem['temp_size_in_bytes'])}")
+            if mem.get("output_size_in_bytes") is not None:
+                parts.append(
+                    f"out {_fmt_bytes(mem['output_size_in_bytes'])}")
+            label = prog.get("label", "?")
+            eng = prog.get("engine")
+            if eng:
+                label = f"{label} [{eng}]"
+            out.write(f"  program {label}: "
+                      + (", ".join(parts) if parts else "(no analysis)")
+                      + "\n")
+        notes = res.get("notes") or {}
+        if notes.get("exchange_bytes_per_round") is not None:
+            out.write(
+                f"  edge-share exchange: "
+                f"{_fmt_bytes(notes['exchange_bytes_per_round'])}/round\n")
+        if notes.get("routed_table_bytes") is not None:
+            out.write(
+                f"  routed tables: "
+                f"{_fmt_bytes(notes['routed_table_bytes'])}\n")
+    if balance:
+        skew = balance.get("sent_skew_max_over_mean")
+        out.write(
+            f"  shard balance ({balance.get('num_shards', '?')} shards): "
+            f"sent={balance.get('sent')}"
+            + (f"  skew {skew:.3f}x max/mean" if isinstance(skew, float)
+               else "")
+            + "\n")
 
 
 def render(data: Dict[str, Any], out: TextIO) -> None:
@@ -232,6 +300,9 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
                 f"push-sum mass drift: |Σs| ≤ {drift:g} ULPs,"
                 f" |Σw − n| ≤ {manifest.get('max_w_drift_ulps', 0.0):g} ULPs\n"
             )
+
+    # resource observatory -----------------------------------------------
+    _render_resources(data, manifest, out)
 
     # convergence sparkline ----------------------------------------------
     if metrics:

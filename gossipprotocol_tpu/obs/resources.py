@@ -1,0 +1,226 @@
+"""Resource observatory: what a run *costs*, not just how long it takes.
+
+Three record families, all host-side (nothing here touches a compiled
+program, so the zero-cost-off contract is trivially safe):
+
+* **compiled-program introspection** — the driver hands every freshly
+  compiled chunk program to :meth:`ResourceRecorder.record_compiled`,
+  which asks XLA for ``cost_analysis()`` (FLOPs, bytes accessed) and
+  ``memory_analysis()`` (argument / output / temp / generated-code
+  bytes — the per-device HBM footprint the capacity planner predicts);
+* **samples** — host RSS (``/proc/self/status``) plus per-device
+  ``memory_stats()`` ``bytes_in_use``, taken at span boundaries and at
+  close, capped at :data:`MAX_SAMPLES` (a dropped-sample counter keeps
+  truncation loud);
+* **notes** — scalar facts other layers compute anyway (edge-share
+  ``all_to_all`` bytes per round, routed table bytes) parked where the
+  report and capacity validation can find them.
+
+Everything is wrapped in broad ``except Exception`` guards: resource
+introspection must never be the reason a run dies.  The document lands
+as ``resources.json`` beside the manifest (atomic tmp+rename) when the
+telemetry hub closes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
+
+# span-boundary samples kept before further ones are dropped (counted)
+MAX_SAMPLES = 256
+
+# cost_analysis() keys worth keeping verbatim (the per-op breakdown keys
+# like "bytes accessed0{}" are backend noise; these are the headline)
+_COST_KEYS = ("flops", "transcendentals", "bytes accessed",
+              "optimal_seconds", "utilization")
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Current resident set size, or None when unknowable."""
+    return _proc_status_bytes("VmRSS")
+
+
+def host_peak_rss_bytes() -> Optional[int]:
+    """Peak (high-water-mark) resident set size."""
+    peak = _proc_status_bytes("VmHWM")
+    if peak is not None:
+        return peak
+    try:  # non-Linux fallback: ru_maxrss is KiB on Linux, bytes on macOS
+        import resource
+        import sys
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(rss if sys.platform == "darwin" else rss * 1024)
+    except Exception:
+        return None
+
+
+def _proc_status_bytes(field: str) -> Optional[int]:
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith(field + ":"):
+                    return int(line.split()[1]) * 1024  # value is in kB
+    except Exception:
+        pass
+    return None
+
+
+def device_info_doc() -> List[Dict[str, Any]]:
+    """One record per jax device: identity + ``memory_stats()`` when the
+    backend exposes them (CPU returns None — recorded as absent, which is
+    itself the answer \"no HBM accounting on this backend\")."""
+    out: List[Dict[str, Any]] = []
+    try:
+        import jax
+
+        for dev in jax.devices():
+            rec: Dict[str, Any] = {
+                "id": int(dev.id),
+                "platform": str(dev.platform),
+                "device_kind": str(getattr(dev, "device_kind", "?")),
+            }
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                rec["memory_stats"] = {
+                    k: int(v) for k, v in stats.items()
+                    if isinstance(v, (int, float))
+                }
+            out.append(rec)
+    except Exception:
+        pass
+    return out
+
+
+def _cost_doc(compiled) -> Optional[Dict[str, Any]]:
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):  # jax < 0.5 wraps in a list
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict):
+        return None
+    return {k: float(v) for k, v in cost.items()
+            if k in _COST_KEYS and isinstance(v, (int, float))}
+
+
+def _memory_doc(compiled) -> Optional[Dict[str, Any]]:
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    doc: Dict[str, Any] = {}
+    for name in dir(mem):
+        if name.startswith("_") or "proto" in name:
+            continue
+        v = getattr(mem, name, None)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            doc[name] = int(v)
+    return doc or None
+
+
+class ResourceRecorder:
+    """Accumulates the resource document for one run."""
+
+    def __init__(self) -> None:
+        self._t0 = time.perf_counter()
+        self.programs: List[Dict[str, Any]] = []
+        self.samples: List[Dict[str, Any]] = []
+        self.samples_dropped = 0
+        self.notes: Dict[str, Any] = {}
+
+    def record_compiled(self, label: str, compiled, **attrs: Any) -> None:
+        """Introspect one compiled chunk program; never raises."""
+        try:
+            rec: Dict[str, Any] = {"label": label}
+            rec.update({k: v for k, v in attrs.items() if v is not None})
+            cost = _cost_doc(compiled)
+            if cost:
+                rec["cost"] = cost
+            mem = _memory_doc(compiled)
+            if mem:
+                rec["memory"] = mem
+            self.programs.append(rec)
+        except Exception:
+            pass
+
+    def sample(self, tag: str) -> None:
+        """Snapshot host RSS + total device bytes-in-use; capped."""
+        if len(self.samples) >= MAX_SAMPLES:
+            self.samples_dropped += 1
+            return
+        rec: Dict[str, Any] = {
+            "tag": tag,
+            "t_s": round(time.perf_counter() - self._t0, 6),
+        }
+        rss = host_rss_bytes()
+        if rss is not None:
+            rec["rss_bytes"] = rss
+        try:
+            import jax
+
+            in_use = 0
+            have = False
+            for dev in jax.devices():
+                stats = dev.memory_stats()
+                if stats and "bytes_in_use" in stats:
+                    in_use += int(stats["bytes_in_use"])
+                    have = True
+            if have:
+                rec["device_bytes_in_use"] = in_use
+        except Exception:
+            pass
+        self.samples.append(rec)
+
+    def note(self, key: str, value: Any) -> None:
+        self.notes[key] = value
+
+    def doc(self) -> Dict[str, Any]:
+        return {
+            "v": SCHEMA_VERSION,
+            "kind": "run_resources",
+            "host": {
+                "rss_bytes": host_rss_bytes(),
+                "peak_rss_bytes": host_peak_rss_bytes(),
+            },
+            "devices": device_info_doc(),
+            "programs": self.programs,
+            "samples": self.samples,
+            "samples_dropped": self.samples_dropped,
+            "notes": self.notes,
+        }
+
+
+def write_resources(out_dir: str, recorder: ResourceRecorder) -> Optional[str]:
+    """Write ``resources.json`` (atomic tmp+rename); never raises."""
+    try:
+        path = os.path.join(out_dir, "resources.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(recorder.doc(), fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, path)
+        return path
+    except Exception:
+        return None
+
+
+def load_resources(out_dir: str) -> Optional[Dict[str, Any]]:
+    """Read ``resources.json`` from a telemetry dir; None when absent or
+    unreadable (partial dirs are normal, not errors)."""
+    try:
+        with open(os.path.join(out_dir, "resources.json")) as fh:
+            return json.load(fh)
+    except Exception:
+        return None

@@ -76,18 +76,41 @@ class Telemetry:
     trace_cap:
         Downsampling cap for ``trace.jsonl`` (rows before the stride
         doubles); ``None`` = ``$GOSSIP_TPU_TRACE_CAP`` or 4096.
+    resources:
+        Record the resource observatory (compiled-program
+        cost/memory_analysis, host RSS + device-memory samples at span
+        boundaries) into ``resources.json``.  ``None`` (default) = on:
+        it is purely host-side, so it never perturbs a compiled program.
+    attribution:
+        Keep the sharded on-device counters *unreduced* per shard so the
+        manifest can report shard-balance skew.  ``None`` (default)
+        follows ``counters``; pass False to keep the counters-only
+        compiled program literally pre-attribution.
     """
 
     enabled = True
     prediction = None  # obs.predict round prediction, set by the driver
+    profile_dir = None  # jax.profiler trace dir when --profile-dir is set
 
     def __init__(self, out_dir: str, *, counters: bool = True,
                  traces: Optional[bool] = None,
-                 trace_cap: Optional[int] = None):
+                 trace_cap: Optional[int] = None,
+                 resources: Optional[bool] = None,
+                 attribution: Optional[bool] = None):
         self.dir = os.path.abspath(out_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.counters_on = bool(counters)
         self.traces_on = bool(counters if traces is None else traces)
+        self.resources_on = bool(True if resources is None else resources)
+        self.attribution_on = bool(
+            self.counters_on if attribution is None else attribution)
+        self.shard_totals = None  # np.int64 [num_shards, 3] when attributed
+        if self.resources_on:
+            from gossipprotocol_tpu.obs.resources import ResourceRecorder
+
+            self._resources = ResourceRecorder()
+        else:
+            self._resources = None
         self._trace_cap = trace_cap
         self._trace_writer = None
         self._t0 = time.perf_counter()
@@ -125,6 +148,28 @@ class Telemetry:
                 rec["attrs"] = sp.attrs
             self._finished.append(rec)
             self._emit(rec)
+            if sp.depth == 0 and self._resources is not None:
+                self._resources.sample(sp.name)
+
+    def mark_span(self, name: str, start_s: float, dur_s: float,
+                  **attrs: Any) -> None:
+        """Record an already-elapsed interval as a *nested* span (depth 1).
+
+        Used for intervals measured outside the ``span()`` context — the
+        jax.profiler trace wraps the whole run, so recording it at depth
+        0 would double-count every phase in the rollup.
+        """
+        rec: Dict[str, Any] = {
+            "kind": "span",
+            "name": name,
+            "start_s": round(start_s, 6),
+            "dur_s": round(dur_s, 6),
+            "depth": 1,
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        self._finished.append(rec)
+        self._emit(rec)
 
     def event(self, name: str, **attrs: Any) -> None:
         """Record an instant (zero-duration) host event."""
@@ -152,6 +197,64 @@ class Telemetry:
     def note_mass_drift(self, s_ulps: float, w_ulps: float) -> None:
         self.max_mass_drift_ulps = max(self.max_mass_drift_ulps, float(s_ulps))
         self.max_w_drift_ulps = max(self.max_w_drift_ulps, float(w_ulps))
+
+    def add_shard_counters(self, totals) -> None:
+        """Accumulate one chunk's per-shard counter partials — an int64
+        ``[num_shards, 3]`` array whose sum over shards the driver has
+        already asserted equals the reduced totals bitwise."""
+        import numpy as np
+
+        totals = np.asarray(totals, dtype=np.int64)
+        if self.shard_totals is None:
+            self.shard_totals = totals.copy()
+        else:
+            self.shard_totals = self.shard_totals + totals
+
+    def shard_balance(self) -> Optional[Dict[str, Any]]:
+        """Per-shard attribution summary for the manifest; None when the
+        run was single-device or attribution was off."""
+        if self.shard_totals is None:
+            return None
+        totals = self.shard_totals
+        sent = totals[:, 0].astype(float)
+        mean = float(sent.mean()) if sent.size else 0.0
+        doc: Dict[str, Any] = {
+            "num_shards": int(totals.shape[0]),
+            "sent": [int(x) for x in totals[:, 0]],
+            "delivered": [int(x) for x in totals[:, 1]],
+            "dropped": [int(x) for x in totals[:, 2]],
+            "sent_skew_max_over_mean": (
+                round(float(sent.max()) / mean, 6) if mean > 0 else None
+            ),
+        }
+        if self._resources is not None:
+            exch = self._resources.notes.get("exchange_bytes_per_round")
+            if isinstance(exch, (int, float)) and totals.shape[0] > 0:
+                doc["edge_share_bytes_per_round_per_shard"] = int(
+                    exch / totals.shape[0])
+        return doc
+
+    # -------------------------------------------------------------- resources
+
+    def record_compiled(self, label: str, compiled, **attrs: Any) -> None:
+        """XLA cost/memory introspection of a freshly compiled program."""
+        if self._resources is not None:
+            self._resources.record_compiled(label, compiled, **attrs)
+
+    def sample_resources(self, tag: str) -> None:
+        if self._resources is not None:
+            self._resources.sample(tag)
+
+    def note_resource(self, key: str, value: Any) -> None:
+        if self._resources is not None:
+            self._resources.note(key, value)
+
+    def write_resources(self) -> Optional[str]:
+        if self._resources is None:
+            return None
+        from gossipprotocol_tpu.obs.resources import write_resources
+
+        return write_resources(self.dir, self._resources)
 
     # ---------------------------------------------------------------- traces
 
@@ -237,6 +340,9 @@ class Telemetry:
             return
         self._closed = True
         try:
+            if self._resources is not None:
+                self._resources.sample("close")
+                self.write_resources()
             self.write_trace()
             self._emit({"kind": "end", "wall_s": round(self.wall_s(), 6)})
         finally:
@@ -269,12 +375,20 @@ class NullTelemetry:
     enabled = False
     counters_on = False
     traces_on = False
+    resources_on = False
+    attribution_on = False
     prediction = None
+    profile_dir = None
+    shard_totals = None
     dir = None
 
     @contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[_Span]:
         yield _NULL_SPAN
+
+    def mark_span(self, name: str, start_s: float, dur_s: float,
+                  **attrs: Any) -> None:
+        pass
 
     def event(self, name: str, **attrs: Any) -> None:
         pass
@@ -287,6 +401,24 @@ class NullTelemetry:
 
     def note_mass_drift(self, s_ulps: float, w_ulps: float) -> None:
         pass
+
+    def add_shard_counters(self, totals) -> None:
+        pass
+
+    def shard_balance(self) -> Optional[Dict[str, Any]]:
+        return None
+
+    def record_compiled(self, label: str, compiled, **attrs: Any) -> None:
+        pass
+
+    def sample_resources(self, tag: str) -> None:
+        pass
+
+    def note_resource(self, key: str, value: Any) -> None:
+        pass
+
+    def write_resources(self) -> Optional[str]:
+        return None
 
     def add_trace_rows(self, start_round: int, rows) -> None:
         pass

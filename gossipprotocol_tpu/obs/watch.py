@@ -70,6 +70,23 @@ def _frame(data: Dict[str, Any], out: TextIO) -> bool:
             f" delivered={counters.get('delivered', 0)}"
             f" dropped={counters.get('dropped', 0)}\n"
         )
+    resources = data.get("resources")
+    if resources:
+        host = resources.get("host") or {}
+        peak = host.get("peak_rss_bytes")
+        if isinstance(peak, (int, float)):
+            samples = resources.get("samples") or []
+            cur = next(
+                (s["rss_bytes"] for s in reversed(samples)
+                 if isinstance(s.get("rss_bytes"), (int, float))),
+                host.get("rss_bytes"),
+            )
+            out.write(
+                f"host RSS  {cur / 2**20:.0f} MiB"
+                f" (peak {peak / 2**20:.0f} MiB)\n"
+                if isinstance(cur, (int, float))
+                else f"host RSS  peak {peak / 2**20:.0f} MiB\n"
+            )
     flags = anomaly_flags(manifest, metrics, trace)
     # a still-running dir has no manifest by design — not an anomaly yet
     flags = [f for f in flags if not f.startswith("run.json missing")

@@ -1292,6 +1292,19 @@ def pull_exchange_bytes_per_round(sd: ShardRoutedDelivery) -> int:
     return 2 * int(sd.n) * 4
 
 
+def table_bytes(sd) -> int:
+    """Total host/device bytes of a delivery-plan pytree (all shards):
+    the static routing-table footprint the capacity planner models and
+    the resource observatory records next to the measured
+    ``memory_analysis`` figures."""
+    import jax as _jax
+
+    return int(sum(
+        leaf.nbytes for leaf in _jax.tree_util.tree_leaves(sd)
+        if hasattr(leaf, "nbytes")
+    ))
+
+
 def pushsum_diffusion_round_routed_sharded(
     state,
     shard_rd: ShardRoutedDelivery,  # this device's slice (leading axis 1)

@@ -333,6 +333,11 @@ def make_sharded_chunk_runner(
             topo, cfg, all_sum=psum_all,
             all_max=lambda x: jax.lax.pmax(jnp.max(x), NODES_AXIS),
         )
+    # per-device attribution: keep the counter partials unreduced per
+    # shard alongside the psum'd buffer. Off keeps this function's jaxpr
+    # literally pre-attribution (the goldens pin it), and on never feeds
+    # back into the round, so the trajectory is bitwise identical.
+    attribution = counter_fn is not None and tel.attribution_on
     if (counter_fn is not None or trace_fn is not None) \
             and counter_slots is None:
         counter_slots = cfg.resolve_chunk_rounds(
@@ -431,6 +436,7 @@ def make_sharded_chunk_runner(
                 cond, body, (state_l, global_done(state_l))
             )
             buf = None
+            sbuf = None
             trace_buf = None
         elif trace_fn is not None:
             # traces (optionally + counters): per-round side buffers in a
@@ -449,13 +455,15 @@ def make_sharded_chunk_runner(
                 bufs = dict(bufs)
                 if counter_fn is not None:
                     alive_cnt = alive_g if alive_g is not None else s.alive
-                    delta = jax.lax.psum(
-                        counter_fn(s, s2, nbrs, base_key, alive_cnt, gids),
-                        NODES_AXIS,
-                    )
+                    raw = counter_fn(s, s2, nbrs, base_key, alive_cnt, gids)
+                    delta = jax.lax.psum(raw, NODES_AXIS)
                     bufs["counters"] = jax.lax.dynamic_update_slice(
                         bufs["counters"], delta[None, :],
                         (row, jnp.int32(0)))
+                    if attribution:
+                        bufs["shard_counters"] = jax.lax.dynamic_update_slice(
+                            bufs["shard_counters"], raw[None, :],
+                            (row, jnp.int32(0)))
                 bufs["trace"] = jax.lax.dynamic_update_slice(
                     bufs["trace"],
                     trace_fn(s2).astype(jnp.float32)[None, :],
@@ -470,11 +478,52 @@ def make_sharded_chunk_runner(
                 (counter_slots, NUM_TRACE_COLS), jnp.float32)}
             if counter_fn is not None:
                 bufs0["counters"] = jnp.zeros((counter_slots, 3), jnp.int32)
+                if attribution:
+                    bufs0["shard_counters"] = jnp.zeros(
+                        (counter_slots, 3), jnp.int32)
             final, done, bufs = jax.lax.while_loop(
                 cond, body, (state_l, global_done(state_l), bufs0)
             )
             buf = bufs.get("counters")
+            sbuf = bufs.get("shard_counters")
             trace_buf = bufs["trace"]
+        elif attribution:
+            # counters + per-shard attribution: the same psum'd buffer
+            # plus the unreduced partials (this shard's own rows; the
+            # P(NODES_AXIS) out spec concatenates shards leading-axis).
+            # raw -> psum(raw) is the identical reduction the plain
+            # branch below compiles, so the psum'd stream stays bitwise.
+            start = state_l.round
+
+            def body(carry):
+                s, _, bufs = carry
+                alive_cnt = alive_g if alive_g is not None else s.alive
+                s2 = round_fn(s)
+                raw = counter_fn(s, s2, nbrs, base_key, alive_cnt, gids)
+                delta = jax.lax.psum(raw, NODES_AXIS)
+                row = s.round - start
+                bufs = dict(bufs)
+                bufs["counters"] = jax.lax.dynamic_update_slice(
+                    bufs["counters"], delta[None, :], (row, jnp.int32(0)))
+                bufs["shard_counters"] = jax.lax.dynamic_update_slice(
+                    bufs["shard_counters"], raw[None, :],
+                    (row, jnp.int32(0)))
+                return s2, global_done(s2), bufs
+
+            def cond(carry):
+                s, done, _ = carry
+                return jnp.logical_and(~done, s.round < round_limit)
+
+            bufs0 = {
+                "counters": jnp.zeros((counter_slots, 3), jnp.int32),
+                "shard_counters": jnp.zeros((counter_slots, 3), jnp.int32),
+            }
+            final, done, bufs = jax.lax.while_loop(
+                cond, body, (state_l, global_done(state_l), bufs0)
+            )
+            buf = bufs["counters"]
+            sbuf = bufs["shard_counters"]
+            trace_buf = None
         else:
             # telemetry counters: per-round int32 deltas in a side buffer
             # (row = round − chunk start). The counter fn re-derives the
@@ -503,6 +552,7 @@ def make_sharded_chunk_runner(
             final, done, buf = jax.lax.while_loop(
                 cond, body, (state_l, global_done(state_l), buf0)
             )
+            sbuf = None
             trace_buf = None
         # replicated on-device stats: one host fetch per chunk (mirrors
         # engine.driver.chunk_stats, with psum/pmin/pmax reductions)
@@ -543,6 +593,8 @@ def make_sharded_chunk_runner(
             )
         if counter_fn is not None:
             stats["counters"] = buf  # already psum-replicated per round
+            if sbuf is not None:
+                stats["shard_counters"] = sbuf  # per-shard, NOT replicated
             # conservation scalars: same reduction for baseline and chunk
             # (mass_stats docstring) — psum of local sums under shard_map
             stats.update(mass_stats(final, all_sum=psum_all))
@@ -571,6 +623,9 @@ def make_sharded_chunk_runner(
                 "plan_cache", provenance=prov, design=cfg.routed_design,
                 num_shards=num_shards, exchange_bytes_per_round=exch,
             )
+            tel.note_resource("exchange_bytes_per_round", exch)
+            tel.note_resource(
+                "routed_table_bytes", sharddelivery.table_bytes(nbrs))
         nbrs_sharded = True  # leading shard axis splits over the mesh
     elif is_pushsum and cfg.fanout == "all":
         # every leaf of the edge pytree is built as equal per-device
@@ -628,6 +683,8 @@ def make_sharded_chunk_runner(
         stats_fields += ["spreading"]
     if counter_fn is not None:
         stats_fields += ["counters"]
+        if attribution:
+            stats_fields += ["shard_counters"]
         if is_pushsum and cfg.workload != "sgp":
             # SGP injects mass every round by design; mass_stats returns
             # nothing for it (see engine.driver.mass_stats)
@@ -635,6 +692,10 @@ def make_sharded_chunk_runner(
     if trace_fn is not None:
         stats_fields += ["trace"]
     stats_specs = {k: P() for k in stats_fields}
+    if attribution:
+        # the one unreduced stat: per-shard [slots, 3] partials gathered
+        # to [num_shards * slots, 3] on the host side
+        stats_specs["shard_counters"] = P(NODES_AXIS)
     sm = shard_map(
         chunk_local,
         mesh=mesh,
@@ -714,12 +775,14 @@ def run_simulation_sharded(
             plans_host, prov = plancache.shard_push_deliveries_cached(
                 run_topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
                 build_workers=cfg.build_workers)
+        exch = sharddelivery.push_exchange_bytes_per_round(plans_host)
         tel.event(
             "plan_cache", provenance=prov, design="push",
-            num_shards=num_shards,
-            exchange_bytes_per_round=(
-                sharddelivery.push_exchange_bytes_per_round(plans_host)),
+            num_shards=num_shards, exchange_bytes_per_round=exch,
         )
+        tel.note_resource("exchange_bytes_per_round", exch)
+        tel.note_resource(
+            "routed_table_bytes", sharddelivery.table_bytes(plans_host))
 
     with tel.span("topology_arrays", engine="sharded"):
         runner, state, nbrs, done_fn, shardings = make_sharded_chunk_runner(
@@ -739,6 +802,8 @@ def run_simulation_sharded(
     t0 = time.perf_counter()
     with tel.span("jit_compile", engine="sharded"):
         compiled = runner.lower(state, nbrs, seed, jnp.int32(0)).compile()
+    tel.record_compiled("chunk", compiled, engine="sharded",
+                        num_shards=num_shards)
 
     def step(s, round_limit):
         return compiled(s, nbrs, seed, jnp.int32(round_limit))
@@ -792,6 +857,8 @@ def run_simulation_sharded(
             nbrs_override=nbrs_over, counter_slots=counter_slots,
         )
         compiled2 = runner2.lower(st, nbrs2, seed, jnp.int32(0)).compile()
+        tel.record_compiled("chunk_rebuild", compiled2, engine="sharded",
+                            num_shards=num_shards)
 
         def step2(s, round_limit):
             return compiled2(s, nbrs2, seed, jnp.int32(round_limit))

@@ -58,14 +58,19 @@ _PROGRAM_CASES = {
 }
 
 
-def _make_telemetry(tmpdir, *, counters):
+def _make_telemetry(tmpdir, *, counters, attribution=None):
     """Telemetry hub with traces OFF regardless of tree version (the
-    ``traces`` kwarg does not exist pre-change)."""
+    ``traces`` kwarg does not exist pre-change; same for the resource
+    observatory's ``attribution``, which is only pinned down when the
+    caller asks for it explicitly)."""
     from gossipprotocol_tpu.obs import Telemetry
 
+    params = inspect.signature(Telemetry.__init__).parameters
     kw = {}
-    if "traces" in inspect.signature(Telemetry.__init__).parameters:
+    if "traces" in params:
         kw["traces"] = False
+    if attribution is not None and "attribution" in params:
+        kw["attribution"] = attribution
     return Telemetry(str(tmpdir), counters=counters, **kw)
 
 
@@ -116,9 +121,15 @@ def _program_digests(tmpdir) -> dict:
             if tel is not None:
                 tel.close()
     for name in ("gossip", "pushsum_one"):
+        # "ctr" carries whatever the counters-on default is (per-shard
+        # attribution rides along since the resource observatory);
+        # "ctr_noattr" pins attribution OFF to the literal pre-observatory
+        # counters-only program
         for label, mk in (
             ("off", lambda: None),
             ("ctr", lambda: _make_telemetry(tmpdir, counters=True)),
+            ("ctr_noattr", lambda: _make_telemetry(
+                tmpdir, counters=True, attribution=False)),
         ):
             tel = mk()
             text = _sharded_lowered(_PROGRAM_CASES[name], tel)

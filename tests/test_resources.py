@@ -1,0 +1,332 @@
+"""Resource observatory (obs/resources.py, obs/capacity.py): compiled-
+program introspection, per-device attribution, and the HBM planner.
+
+The load-bearing claims:
+
+* per-shard counter partials sum BITWISE to the psum'd totals at every
+  shard count (the attribution buffer is the same adds, unreduced);
+* ``resources.json`` lands beside the manifest with host RSS, program
+  cost/memory docs, and boundary samples — and ``report`` renders it,
+  including from a partial dir (crashed run: no events/trace);
+* the capacity model's predicted argument bytes track XLA's own
+  ``memory_analysis()`` within a pinned tolerance on real configs;
+* ``plan`` renders the breakdown and exits 0 (fits) / 1 (over capacity)
+  / 2 (bad input); the run CLI refuses over-capacity requests up front.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.cli import main as cli_main
+from gossipprotocol_tpu.obs import Telemetry
+from gossipprotocol_tpu.obs.capacity import (
+    CapacityError,
+    estimate_for_topology,
+    estimate_run_bytes,
+    max_feasible_nodes,
+)
+from gossipprotocol_tpu.obs.report import main as report_main
+from gossipprotocol_tpu.obs.resources import (
+    ResourceRecorder,
+    host_peak_rss_bytes,
+    host_rss_bytes,
+    load_resources,
+    write_resources,
+)
+from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+# predicted argument bytes vs memory_analysis(): the model accounts for
+# state + delivery + key exactly, but XLA adds padding/layout slack and
+# small scalars the model rounds away
+ARG_BYTES_REL_TOL = 0.35
+
+
+# ------------------------------------------------------- attribution
+
+
+@pytest.mark.parametrize("num_shards", [2, 4, 8])
+def test_shard_partials_sum_bitwise(num_shards, tmp_path, cpu_devices):
+    """Per-shard sent/delivered/dropped partials must sum EXACTLY to the
+    psum'd totals — same integer adds, just unreduced (int32 is exact)."""
+    topo = build_topology("line", 64, seed=0)
+    tel = Telemetry(str(tmp_path / "tel"))
+    cfg = RunConfig(algorithm="push-sum", seed=3, max_rounds=400,
+                    telemetry=tel)
+    mesh = make_mesh(devices=cpu_devices[:num_shards])
+    res = run_simulation_sharded(topo, cfg, mesh=mesh)
+    tel.close()
+    assert res.converged
+    assert tel.shard_totals is not None
+    per_shard = np.asarray(tel.shard_totals)
+    assert per_shard.shape == (num_shards, 3)
+    total = per_shard.sum(axis=0)
+    expect = [tel.totals["sent"], tel.totals["delivered"],
+              tel.totals["dropped"]]
+    assert total.tolist() == expect
+    assert tel.totals["sent"] > 0
+    # a line graph split into contiguous shards is near-balanced
+    balance = tel.shard_balance()
+    assert balance is not None and balance["num_shards"] == num_shards
+    assert balance["sent_skew_max_over_mean"] >= 1.0
+
+
+def test_attribution_off_keeps_counters(tmp_path, cpu_devices):
+    """attribution=False runs the counters-only program: totals intact,
+    no per-shard buffer."""
+    topo = build_topology("line", 32, seed=0)
+    tel = Telemetry(str(tmp_path / "tel"), attribution=False)
+    cfg = RunConfig(algorithm="gossip", seed=1, max_rounds=400,
+                    telemetry=tel)
+    run_simulation_sharded(topo, cfg, mesh=make_mesh(devices=cpu_devices[:2]))
+    tel.close()
+    assert tel.totals["sent"] > 0
+    assert tel.shard_totals is None
+    assert tel.shard_balance() is None
+
+
+def test_shard_balance_in_manifest(tmp_path, cpu_devices):
+    topo = build_topology("line", 48, seed=0)
+    tel = Telemetry(str(tmp_path / "tel"))
+    cfg = RunConfig(algorithm="push-sum", seed=2, max_rounds=400,
+                    telemetry=tel)
+    res = run_simulation_sharded(topo, cfg,
+                                 mesh=make_mesh(devices=cpu_devices[:2]))
+    from gossipprotocol_tpu.obs import write_manifest
+
+    write_manifest(tel, cfg, topo, res, backend="cpu", num_devices=2)
+    tel.close()
+    with open(tmp_path / "tel" / "run.json") as fh:
+        manifest = json.load(fh)
+    balance = manifest["shard_balance"]
+    assert balance["num_shards"] == 2
+    assert len(balance["sent"]) == 2
+    assert sum(balance["sent"]) == manifest["counters"]["sent"]
+    assert manifest["resources"] == "resources.json"
+
+
+# ------------------------------------------------------- resources.json
+
+
+def test_host_rss_probes():
+    rss = host_rss_bytes()
+    peak = host_peak_rss_bytes()
+    assert rss and rss > 2**20
+    assert peak and peak >= rss * 0.5  # VmHWM >= VmRSS up to sampling race
+
+
+def test_run_writes_resources_json(tmp_path):
+    tel = Telemetry(str(tmp_path / "tel"))
+    topo = build_topology("line", 32, seed=0)
+    cfg = RunConfig(algorithm="push-sum", seed=0, max_rounds=400,
+                    telemetry=tel)
+    run_simulation(topo, cfg)
+    tel.close()
+    doc = load_resources(str(tmp_path / "tel"))
+    assert doc is not None and doc["kind"] == "run_resources"
+    assert doc["host"]["peak_rss_bytes"] > 0
+    labels = [p["label"] for p in doc["programs"]]
+    assert "chunk" in labels
+    chunk = doc["programs"][labels.index("chunk")]
+    # CPU XLA reports exact cost/memory analysis for compiled programs
+    assert chunk["cost"].get("flops", 0) >= 0
+    assert chunk["memory"].get("argument_size_in_bytes", 0) > 0
+    # span-boundary samples accumulated (jit_compile, chunk, close, ...)
+    assert len(doc["samples"]) >= 2
+
+
+def test_recorder_never_raises_and_caps(tmp_path):
+    rec = ResourceRecorder()
+    rec.record_compiled("bogus", object())  # no cost_analysis: swallowed
+    for i in range(5000):
+        rec.sample(f"s{i}")
+    doc = rec.doc()
+    assert len(doc["samples"]) <= 256 + 1
+    assert doc["samples_dropped"] > 0
+    write_resources(str(tmp_path), rec)
+    assert load_resources(str(tmp_path))["samples_dropped"] > 0
+
+
+def test_report_renders_resources_on_partial_dir(tmp_path, capsys):
+    """A crashed run leaves run.json + resources.json but maybe no
+    events/trace — report must still render the resources section."""
+    d = tmp_path / "tel"
+    d.mkdir()
+    (d / "run.json").write_text(json.dumps({
+        "v": 1, "kind": "run_manifest",
+        "config": {"algorithm": "push-sum"},
+        "topology": {"kind": "line", "num_nodes": 8},
+        "result": None, "counters": None, "phases": {}, "wall_s": 0.1,
+        "resources": "resources.json",
+    }))
+    rec = ResourceRecorder()
+    rec.sample("probe")
+    rec.note("exchange_bytes_per_round", 4096)
+    write_resources(str(d), rec)
+    assert report_main([str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "resources:" in out
+    assert "host RSS" in out
+    assert "exchange" in out
+
+
+def test_history_ingests_resource_metrics(tmp_path):
+    from gossipprotocol_tpu.obs.history import build_index
+
+    d = tmp_path / "artifacts" / "run1"
+    d.mkdir(parents=True)
+    (d / "run.json").write_text(json.dumps({
+        "v": 1, "kind": "run_manifest",
+        "config": {"algorithm": "gossip"},
+        "topology": {"kind": "line", "num_nodes": 8},
+        "result": {"converged": True, "rounds": 3, "wall_ms": 1.0},
+    }))
+    rec = ResourceRecorder()
+    rec.record_compiled("chunk", _FakeCompiled())
+    write_resources(str(d), rec)
+    records = build_index(str(tmp_path), write=False)
+    runs = [r for r in records if r["kind"] == "run"]
+    assert runs and runs[0]["peak_rss_bytes"] > 0
+    assert runs[0]["chunk_flops"] == 123.0
+    assert runs[0]["chunk_argument_bytes"] == 4096
+
+
+class _FakeCompiled:
+    def cost_analysis(self):
+        return [{"flops": 123.0}]
+
+    def memory_analysis(self):
+        class _M:
+            argument_size_in_bytes = 4096
+            temp_size_in_bytes = 128
+        return _M()
+
+
+# ------------------------------------------------------- capacity model
+
+
+@pytest.mark.parametrize("cfg_kw", [
+    dict(algorithm="push-sum"),
+    dict(algorithm="gossip"),
+    dict(algorithm="push-sum", fanout="all", predicate="global"),
+    dict(algorithm="push-sum", fanout="all", predicate="global",
+         payload_dim=8),
+])
+def test_capacity_tracks_memory_analysis(cfg_kw, tmp_path):
+    """Predicted argument bytes vs the compiled chunk program's own
+    memory_analysis(), within the pinned relative tolerance."""
+    tel = Telemetry(str(tmp_path / "tel"))
+    topo = build_topology("line", 512, seed=0)
+    cfg = RunConfig(seed=0, max_rounds=40, streak_target=2**30,
+                    telemetry=tel, **cfg_kw)
+    run_simulation(topo, cfg)
+    tel.close()
+    doc = load_resources(str(tmp_path / "tel"))
+    chunk = next(p for p in doc["programs"] if p["label"] == "chunk")
+    actual = chunk["memory"].get("argument_size_in_bytes")
+    if not actual:
+        pytest.skip("memory_analysis reports no argument bytes here")
+    est = estimate_for_topology(topo, cfg, 1)
+    rel = abs(est["argument_bytes"] - actual) / actual
+    assert rel <= ARG_BYTES_REL_TOL, (
+        f"estimate {est['argument_bytes']} vs measured {actual} "
+        f"({rel:.0%} > {ARG_BYTES_REL_TOL:.0%}) — {est}"
+    )
+
+
+def test_estimate_scales_and_searches():
+    cfg = RunConfig(algorithm="push-sum")
+    small = estimate_run_bytes("line", 10_000, cfg, 1)
+    big = estimate_run_bytes("line", 1_000_000, cfg, 1)
+    ratio = (big["per_device"]["total_bytes"]
+             / small["per_device"]["total_bytes"])
+    assert 50 <= ratio <= 150  # ~linear in n
+    sharded = estimate_run_bytes("line", 1_000_000, cfg, 8)
+    assert (sharded["per_device"]["state_bytes"]
+            < big["per_device"]["state_bytes"] / 4)
+    # monotone feasibility search: the found n fits, n+... does not
+    cap = 64 * 2**20
+    n_max = max_feasible_nodes("line", cfg, 1, cap)
+    assert n_max > 0
+    fits = estimate_run_bytes("line", n_max, cfg, 1)
+    over = estimate_run_bytes("line", n_max * 2, cfg, 1)
+    assert fits["per_device"]["total_bytes"] <= 0.9 * cap
+    assert over["per_device"]["total_bytes"] > 0.9 * cap
+
+
+def test_estimate_bad_input():
+    cfg = RunConfig(algorithm="push-sum")
+    with pytest.raises(CapacityError):
+        estimate_run_bytes("line", 0, cfg, 1)
+    with pytest.raises((CapacityError, ValueError)):
+        estimate_run_bytes("not_a_topology", 100, cfg, 1)
+
+
+# ------------------------------------------------------- plan subcommand
+
+
+def run_cli(args, capsys):
+    code = cli_main(args)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+def test_plan_fits(capsys):
+    code, out, err = run_cli(
+        ["plan", "100000", "line", "push-sum",
+         "--hbm-bytes", str(16 * 2**30)], capsys)
+    assert code == 0, err
+    for needle in ("capacity plan: push-sum on line-100000",
+                   "state:", "delivery:", "total:",
+                   "max feasible n", "verdict: fits"):
+        assert needle in out, f"plan output missing {needle!r}:\n{out}"
+
+
+def test_plan_over_capacity_exits_nonzero(capsys):
+    code, out, err = run_cli(
+        ["plan", "100000000", "erdos_renyi", "push-sum",
+         "--devices", "4", "--hbm-bytes", str(2**30)], capsys)
+    assert code == 1, err
+    assert "OVER CAPACITY" in out
+    assert "max feasible n" in out
+
+
+def test_plan_bad_input(capsys):
+    code, _, err = run_cli(["plan", "1000", "not_a_topology"], capsys)
+    assert code == 2
+    assert "plan:" in err
+    code, _, err = run_cli(
+        ["plan", "0", "line", "--hbm-bytes", "1"], capsys)
+    assert code == 2
+
+
+def test_plan_json_mode(capsys):
+    code, out, _ = run_cli(
+        ["plan", "4096", "3D", "push-sum", "--fanout", "all",
+         "--delivery", "scatter", "--hbm-bytes", str(2**30), "--json"],
+        capsys)
+    assert code == 0
+    doc = json.loads(out)
+    assert doc["kind"] == "3D"
+    assert doc["per_device"]["total_bytes"] > 0
+    assert doc["capacity_source"] == "--hbm-bytes"
+
+
+def test_run_cli_refuses_over_capacity(tmp_path, capsys, monkeypatch):
+    """The admission-control hook: an over-budget run is refused before
+    any plan build, exit 2, with the planner's actionable message."""
+    monkeypatch.setenv("GOSSIP_TPU_HBM_BYTES", "1000000")
+    code, _, err = run_cli(
+        ["100000", "line", "push-sum", "--max-rounds", "5", "--quiet"],
+        capsys)
+    assert code == 2
+    assert "exceeds" in err and "max feasible n" in err
+    # and a request under the budget still runs
+    monkeypatch.setenv("GOSSIP_TPU_HBM_BYTES", str(16 * 2**30))
+    code, _, err = run_cli(
+        ["32", "line", "push-sum", "--max-rounds", "400", "--quiet"],
+        capsys)
+    assert code == 0, err
