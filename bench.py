@@ -91,8 +91,95 @@ def _bench_telemetry_dir() -> str:
     return os.path.join(root, "artifacts", f"bench_telemetry_r{nn:02d}")
 
 
+def _delivery_microbench() -> None:
+    """``BENCH_DELIVERY_ONLY=1``: time the delivery matvec alone.
+
+    Skips every convergence benchmark and measures ONLY the steady-state
+    expand→route→reduce matvec for the routed and pallas delivery paths
+    on the same imp3D topology — the delivery kernel is what the pallas
+    path changes, so this isolates the comparison from round arithmetic,
+    predicate evaluation and host chunking. Prints ONE JSON line with a
+    ``paths`` entry per delivery and asserts the two outputs are bitwise
+    equal first (a wrong-fast kernel must not produce a datapoint).
+
+    Knobs: ``BENCH_DELIVERY_NODES`` (default 200k), ``BENCH_DELIVERY_ITERS``
+    (timed matvecs per path, default 30).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gossipprotocol_tpu import build_topology
+    from gossipprotocol_tpu.ops import delivery as routed_mod
+    from gossipprotocol_tpu.ops import pallasdelivery as pallas_mod
+
+    n = int(os.environ.get("BENCH_DELIVERY_NODES", 200_000))
+    iters = int(os.environ.get("BENCH_DELIVERY_ITERS", 30))
+    interpret = jax.default_backend() != "tpu"
+    topo = build_topology("imp3D", n, seed=0)
+
+    xs0 = jax.random.uniform(jax.random.PRNGKey(0), (topo.num_nodes,),
+                             jnp.float32)
+    xw0 = jnp.ones((topo.num_nodes,), jnp.float32)
+
+    paths = {}
+    outputs = {}
+    for name, build, to_dev in (
+        ("routed", routed_mod.build_routed_delivery, routed_mod.to_device),
+        ("pallas", pallas_mod.build_pallas_delivery, pallas_mod.to_device),
+    ):
+        t0 = time.perf_counter()
+        d = to_dev(build(topo))
+        build_s = time.perf_counter() - t0
+
+        fn = jax.jit(
+            lambda a, b, d=d: d.matvec(a, b, interpret=interpret))
+        t0 = time.perf_counter()
+        ys, yw = fn(xs0, xw0)
+        jax.block_until_ready((ys, yw))
+        compile_s = time.perf_counter() - t0
+        outputs[name] = (np.asarray(ys), np.asarray(yw))
+
+        # steady state: feed the output back through the same delivery
+        # (mass-conserving shares stay bounded) so the device never idles
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ys, yw = fn(ys, yw)
+        jax.block_until_ready((ys, yw))
+        total_s = time.perf_counter() - t0
+        paths[name] = {
+            "matvec_ms": round(total_s / iters * 1e3, 3),
+            "build_s": round(build_s, 3),
+            "compile_s": round(compile_s, 3),
+        }
+        if name == "pallas":
+            paths[name]["gather_mode"] = d.gather_pre.mode
+
+    # correctness oracle before any speedup claim
+    np.testing.assert_array_equal(outputs["routed"][0], outputs["pallas"][0])
+    np.testing.assert_array_equal(outputs["routed"][1], outputs["pallas"][1])
+
+    print(json.dumps({
+        "metric": "delivery_matvec_imp3d",
+        "nodes": topo.num_nodes,
+        "iters": iters,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "interpret": interpret,
+        "bitwise_equal": True,
+        "pallas_vs_routed": round(
+            paths["routed"]["matvec_ms"] / paths["pallas"]["matvec_ms"], 2),
+        "paths": paths,
+        "peak_rss_bytes": _peak_rss(),
+    }))
+
+
 def main():
     _probe_backend()
+
+    if os.environ.get("BENCH_DELIVERY_ONLY", "0") == "1":
+        _delivery_microbench()
+        return
 
     import jax
 

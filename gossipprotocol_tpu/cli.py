@@ -296,7 +296,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "fanout-all diffusion variant that converges at "
                         "graph mixing time (required for hub-heavy graphs "
                         "like power-law at scale)")
-    p.add_argument("--delivery", choices=["scatter", "invert", "routed"],
+    p.add_argument("--delivery",
+                   choices=["scatter", "invert", "routed", "pallas"],
                    default="scatter",
                    help="push-sum delivery. fanout-one: segment_sum "
                         "scatter-add, or 'invert' — the receiver-side "
@@ -310,7 +311,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "21x faster at 10M power-law). Under --devices N "
                         "each shard runs a directed per-shard plan after "
                         "one all_gather — bitwise the single-chip "
-                        "trajectory")
+                        "trajectory. 'pallas': the routed pipeline fused "
+                        "into bucketed Pallas gather kernels (same plan "
+                        "geometry, bitwise equal to 'routed'); under "
+                        "--devices N the push design's all_to_all becomes "
+                        "per-destination async remote-copy DMAs — see "
+                        "README 'Performance'")
     p.add_argument("--routed-design", choices=["pull", "push"], default=None,
                    help="sharded routed delivery variant (requires "
                         "--delivery routed with --devices N). 'push' "
@@ -672,18 +678,19 @@ def main(argv=None) -> int:
                     "or use delivery='scatter'"
                 )
         if args.routed_design is not None and (
-                cfg.delivery != "routed" or args.devices <= 1):
+                cfg.delivery not in ("routed", "pallas")
+                or args.devices <= 1):
             raise ValueError(
                 "--routed-design selects between the sharded routed "
-                "delivery variants — it needs --delivery routed AND "
-                "--devices N (got delivery=%r, devices=%d)"
-                % (cfg.delivery, args.devices)
+                "delivery variants — it needs --delivery routed (or "
+                "pallas, push-only) AND --devices N (got delivery=%r, "
+                "devices=%d)" % (cfg.delivery, args.devices)
             )
-        if cfg.delivery == "routed" and topo.implicit_full:
+        if cfg.delivery in ("routed", "pallas") and topo.implicit_full:
             raise ValueError(
-                "delivery='routed' needs an explicit edge list; the "
-                "complete graph has none (diffusion on K_n mixes in one "
-                "round via two reductions) — use delivery='scatter'"
+                f"delivery='{cfg.delivery}' needs an explicit edge list; "
+                "the complete graph has none (diffusion on K_n mixes in "
+                "one round via two reductions) — use delivery='scatter'"
             )
         if (args.devices > 1 and algo == "push-sum"
                 and args.semantics == "reference"):

@@ -219,10 +219,10 @@ class RunConfig:
                 "edge_chunks applies to fanout-all diffusion only (the "
                 "other senders have no per-edge intermediates to slice)"
             )
-        if self.edge_chunks > 1 and self.delivery == "routed":
+        if self.edge_chunks > 1 and self.delivery in ("routed", "pallas"):
             raise ValueError(
                 "edge_chunks applies to the scatter delivery; the routed "
-                "plans stream at fixed memory already"
+                "and pallas plans stream at fixed memory already"
             )
         if self.fanout == "all" and self.semantics == "reference":
             raise ValueError(
@@ -230,9 +230,9 @@ class RunConfig:
                 "single-target send IS the reference's accidental behavior "
                 "(Program.fs:128) that the diffusion variant replaces"
             )
-        if self.delivery not in ("scatter", "invert", "routed"):
-            raise ValueError("delivery must be 'scatter', 'invert', or "
-                             "'routed'")
+        if self.delivery not in ("scatter", "invert", "routed", "pallas"):
+            raise ValueError("delivery must be 'scatter', 'invert', "
+                             "'routed', or 'pallas'")
         sched = self.schedule.validate()  # structural check, loud + early
         from gossipprotocol_tpu.topology.repair import validate_policy
 
@@ -259,13 +259,17 @@ class RunConfig:
                 "written this run",
                 stacklevel=2,
             )
-        if self.delivery == "routed":
+        if self.delivery in ("routed", "pallas"):
+            # pallas shares the routed contract exactly: it is the same
+            # plan geometry with the copy chain fused into gather
+            # kernels (ops/pallasdelivery.py), held bitwise equal
             if self.algorithm != "push-sum" or self.fanout != "all":
                 raise ValueError(
-                    "delivery='routed' applies to fanout-all diffusion "
-                    "only (the static edge structure is what the routing "
-                    "plan compiles; single-target draws fresh targets "
-                    "every round — see README 'Performance')"
+                    f"delivery='{self.delivery}' applies to fanout-all "
+                    "diffusion only (the static edge structure is what "
+                    "the routing plan compiles; single-target draws "
+                    "fresh targets every round — see README "
+                    "'Performance')"
                 )
             # kill/revive strikes are fine: the driver's kill_disconnected
             # keeps the dead set well-defined and the routed round's
@@ -274,15 +278,22 @@ class RunConfig:
             # compiled once and cannot thread a fresh per-edge mask.
             if sched.has_loss:
                 raise ValueError(
-                    "delivery='routed' compiles a static routing plan and "
-                    "cannot apply per-edge drop masks through it; use "
-                    "delivery='scatter' for loss windows"
+                    f"delivery='{self.delivery}' compiles a static "
+                    "routing plan and cannot apply per-edge drop masks "
+                    "through it; use delivery='scatter' for loss windows"
                 )
             if jnp.dtype(self.dtype) != jnp.float32:
                 raise ValueError(
-                    "delivery='routed' routes f32 lane pairs; use "
-                    "delivery='scatter' for float64 runs"
+                    f"delivery='{self.delivery}' routes f32 lane pairs; "
+                    "use delivery='scatter' for float64 runs"
                 )
+        if self.delivery == "pallas" and self.routed_design == "pull":
+            raise ValueError(
+                "delivery='pallas' shards with the push design only "
+                "(the async remote-copy exchange replaces the push "
+                "path's all_to_all; pull has no edge-share exchange "
+                "to replace) — drop routed_design='pull'"
+            )
         if self.routed_design not in ("push", "pull"):
             raise ValueError("routed_design must be 'push' or 'pull'")
         if self.delivery == "invert":
@@ -414,8 +425,11 @@ class RunConfig:
         if self.algorithm == "push-sum" and self.fanout == "all":
             # routed delivery replaces the per-edge random scatter with
             # stream-speed routing passes (measured ~6 ns/pair + class
-            # overhead, experiments/route_bench.py)
-            per_edge = 12e-9 if self.delivery == "routed" else 65e-9
+            # overhead, experiments/route_bench.py); pallas fuses those
+            # passes into single gathers — budget it the same, erring
+            # toward smaller chunks
+            per_edge = (12e-9 if self.delivery in ("routed", "pallas")
+                        else 65e-9)
             per_round_s += (num_edges or 0) * per_edge
         if jnp.dtype(self.dtype) == jnp.float64:
             per_round_s *= 16
@@ -619,8 +633,11 @@ def build_protocol(
                     "reductions with no edges to mask — materialize the "
                     "topology or drop the loss windows"
                 )
+            # pallas rides the routed round unchanged: the delivery
+            # pytree (RoutedDelivery vs PallasDelivery) carries the
+            # kernels; the round only calls .matvec/.degree
             round_fn = (pushsum_diffusion_round_routed
-                        if cfg.delivery == "routed"
+                        if cfg.delivery in ("routed", "pallas")
                         else pushsum_diffusion_round)
             core = partial(
                 round_fn,
@@ -632,13 +649,13 @@ def build_protocol(
                 all_alive=all_alive,
                 targets_alive=targets_alive,
             )
-            if cfg.delivery != "routed":
+            if cfg.delivery not in ("routed", "pallas"):
                 # routed runs never carry loss (RunConfig rejects it); the
                 # scatter round threads the drop windows through delivery
                 core = partial(core, loss_windows=loss_windows)
-            if cfg.delivery != "routed" and cfg.edge_chunks > 1:
-                core = partial(core, edge_chunks=cfg.edge_chunks)
-            if cfg.delivery == "routed":
+                if cfg.edge_chunks > 1:
+                    core = partial(core, edge_chunks=cfg.edge_chunks)
+            else:
                 core = partial(
                     core, interpret=(default_platform() != "tpu"))
         elif ref:
@@ -883,6 +900,21 @@ def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
                         rd),
                 )
             return rd
+        if cfg.delivery == "pallas":
+            from gossipprotocol_tpu.ops.pallasdelivery import (
+                pallas_streamed_bytes_per_round,
+            )
+            from gossipprotocol_tpu.ops.plancache import pallas_delivery_cached
+
+            pd, prov = pallas_delivery_cached(topo, cache_dir=cfg.plan_cache)
+            if tel is not None and tel.enabled:
+                tel.event(
+                    "plan_cache", provenance=prov, design="single-chip",
+                    delivery="pallas",
+                    streamed_bytes_per_round=pallas_streamed_bytes_per_round(
+                        pd),
+                )
+            return pd
         from gossipprotocol_tpu.protocols.diffusion import diffusion_edges
 
         return diffusion_edges(topo)
@@ -1616,7 +1648,8 @@ def run_simulation(
     t0 = time.perf_counter()
     with tel.span("jit_compile", engine="single-chip"):
         compiled = runner.lower(state, nbrs, base_key, jnp.int32(0)).compile()
-    tel.record_compiled("chunk", compiled, engine="single-chip")
+    tel.record_compiled("chunk", compiled, engine="single-chip",
+                        delivery=cfg.delivery)
 
     def step(s, round_limit):
         return compiled(s, nbrs, base_key, jnp.int32(round_limit))
@@ -1645,7 +1678,8 @@ def run_simulation(
             trace_slots=counter_slots,
         )
         compiled2 = runner2.lower(st, nbrs2, base_key, jnp.int32(0)).compile()
-        tel.record_compiled("chunk_rebuild", compiled2, engine="single-chip")
+        tel.record_compiled("chunk_rebuild", compiled2,
+                            engine="single-chip", delivery=cfg.delivery)
 
         def step2(s, round_limit):
             return compiled2(s, nbrs2, base_key, jnp.int32(round_limit))

@@ -55,6 +55,11 @@ class CapacityError(ValueError):
 # measured routed-plan footprint (ops/sharddelivery.py docstring):
 # ~86 bytes per directed edge across plan_in/m/out + class tables
 ROUTED_BYTES_PER_EDGE = 86
+# pallas gather-table slot cost (ops/pallasdelivery.py): one int32 per
+# f32 reduce slot resident, plus the per-tile source-row table (worst
+# case one entry per slot) once the source overflows VMEM residency
+PALLAS_SLOT_BYTES_RESIDENT = 4
+PALLAS_SLOT_BYTES_BUCKET = 8
 # refuse runs predicted above this fraction of per-device capacity —
 # XLA needs allocator headroom beyond the model's accounted buffers
 DEFAULT_SAFETY = 0.9
@@ -128,6 +133,42 @@ def _state_row_bytes(cfg) -> Tuple[float, int]:
     return row, fixed
 
 
+def _pallas_gather_bytes(e_local: int, local_rows: int,
+                         max_degree: int) -> int:
+    """Single-chip pallas delivery tables, sized the way
+    ``ops.pallasdelivery.build_gather_plan`` sizes them: the pre-reduce
+    map covers the class-layout pair slots (edges PLUS the BLK-row
+    quantization floor every populated small class pays), the output map
+    covers 2·n slots, each map priced per slot by the gather mode its
+    source size forces (resident int32 index vs bucketed index + row
+    table), plus the int32 degree vector."""
+    from gossipprotocol_tpu.ops.classops import BLK
+    from gossipprotocol_tpu.ops.pallasdelivery import (
+        LANES, TILE, _resident_rows,
+    )
+
+    # populated-class upper bound from the degree range: one ceil-pow2
+    # class per octave up to max_degree, with the 128/256 band merged
+    # into 512 (delivery.degree_classes)
+    cp2 = 1 << max(0, (max(1, max_degree) - 1)).bit_length()
+    n_classes = cp2.bit_length()
+    if cp2 >= 512:
+        n_classes -= 2
+    pairs = e_local + n_classes * BLK * (LANES // 2)
+
+    resident = _resident_rows()
+
+    def per_slot(src_rows: int) -> int:
+        return (PALLAS_SLOT_BYTES_RESIDENT if src_rows <= resident
+                else PALLAS_SLOT_BYTES_BUCKET)
+
+    pre_slots = -(-2 * pairs // TILE) * TILE
+    out_slots = -(-2 * local_rows // TILE) * TILE
+    pre = per_slot(-(-(2 * local_rows + 1) // LANES)) * pre_slots
+    out = per_slot(-(-2 * pairs // LANES)) * out_slots
+    return pre + out + 4 * local_rows
+
+
 def _delivery_bytes(cfg, n_pad: int, local_rows: int, num_shards: int,
                     num_edges: int, max_degree: int,
                     implicit_full: bool) -> Tuple[int, str]:
@@ -149,6 +190,16 @@ def _delivery_bytes(cfg, n_pad: int, local_rows: int, num_shards: int,
             # exchange slab [num_shards, 2·block_pairs]
             slab = 4 * num_edges if num_shards > 1 else 0
             return ROUTED_BYTES_PER_EDGE * e_local + slab, "routed"
+        if cfg.delivery == "pallas":
+            if num_shards > 1:
+                # sharded pallas keeps the push design's per-shard plan
+                # tables (same geometry) — only the exchange transport
+                # changes, and the remote-copy landing buffer matches
+                # the all_to_all slab byte-for-byte
+                slab = 4 * num_edges
+                return ROUTED_BYTES_PER_EDGE * e_local + slab, "pallas"
+            return _pallas_gather_bytes(e_local, local_rows,
+                                        max_degree), "pallas"
         # diffusion edge list: src+dst int32 per edge (+ valid byte when
         # sharded blocks carry padding) + row-aligned degree
         per_edge = 8 + (1 if num_shards > 1 else 0)
@@ -234,6 +285,24 @@ def estimate_run_bytes(
 
     argument_bytes = state_bytes + delivery_bytes + data_bytes + 16
     total = argument_bytes + temp_bytes + telemetry_bytes
+    extra_per_device: Dict[str, int] = {}
+    if path == "pallas" and num_shards == 1:
+        # mirror the gather kernel's VMEM story (ops/pallasdelivery.py):
+        # a source at or under the resident-row threshold rides whole in
+        # VMEM; past it the kernel stages [R, 128] row slabs, R bounded
+        # by the 1024 slots of one destination tile. Advisory (VMEM is
+        # not HBM) — rendered by `plan` so kernel-budget regressions
+        # show up before a Mosaic allocation failure does
+        from gossipprotocol_tpu.ops.pallasdelivery import (
+            LANES as _PL_LANES, TILE as _PL_TILE, _resident_rows,
+        )
+
+        src_rows = -(-(2 * n + 1) // _PL_LANES)
+        # bucket-mode R is capped by the slots of one destination tile
+        scratch_rows = (src_rows if src_rows <= _resident_rows()
+                        else min(src_rows, _PL_TILE))
+        extra_per_device["pallas_vmem_scratch_bytes"] = (
+            scratch_rows * _PL_LANES * 4)
     return {
         "kind": canonical_name(kind),
         "num_nodes": n,
@@ -249,6 +318,7 @@ def estimate_run_bytes(
             "data_bytes": int(data_bytes),
             "temp_bytes": int(temp_bytes),
             "telemetry_bytes": int(telemetry_bytes),
+            **extra_per_device,
             "total_bytes": int(total),
         },
         "argument_bytes": int(argument_bytes),
@@ -375,7 +445,7 @@ def main(argv=None) -> int:
     parser.add_argument("--devices", type=int, default=1)
     parser.add_argument("--fanout", choices=["one", "all"], default="one")
     parser.add_argument("--delivery", default=None,
-                        choices=["scatter", "invert", "routed"])
+                        choices=["scatter", "invert", "routed", "pallas"])
     parser.add_argument("--payload-dim", type=int, default=1)
     parser.add_argument("--workload", choices=["avg", "sgp"], default="avg")
     parser.add_argument("--sgp-samples", type=int, default=16)
@@ -458,6 +528,10 @@ def main(argv=None) -> int:
             print(f"  workload data:{_fmt(per['data_bytes']):>12}/device")
         print(f"  temp (est):   {_fmt(per['temp_bytes']):>12}/device")
         print(f"  telemetry:    {_fmt(per['telemetry_bytes']):>12}/device")
+        if "pallas_vmem_scratch_bytes" in per:
+            print(f"  vmem scratch: "
+                  f"{_fmt(per['pallas_vmem_scratch_bytes']):>12}/kernel"
+                  "  (advisory: VMEM, not HBM)")
         print(f"  total:        {_fmt(per['total_bytes']):>12}/device"
               f"  (argument bytes {_fmt(doc['argument_bytes'])})")
 

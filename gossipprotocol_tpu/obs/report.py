@@ -178,9 +178,10 @@ def _render_resources(data: Dict[str, Any], manifest, out: TextIO) -> None:
                 parts.append(
                     f"out {_fmt_bytes(mem['output_size_in_bytes'])}")
             label = prog.get("label", "?")
-            eng = prog.get("engine")
-            if eng:
-                label = f"{label} [{eng}]"
+            tags = [t for t in (prog.get("engine"), prog.get("delivery"))
+                    if t]
+            if tags:
+                label = f"{label} [{', '.join(tags)}]"
             out.write(f"  program {label}: "
                       + (", ".join(parts) if parts else "(no analysis)")
                       + "\n")

@@ -1,0 +1,487 @@
+"""Bucketed Pallas delivery: the routed pipeline fused to two gathers.
+
+The routed delivery (:mod:`gossipprotocol_tpu.ops.delivery`) spends its
+round on SIX routed passes (two chained plans each for plan_in, plan_m,
+plan_out) plus the expand kernels — every pass a full read+write of the
+``[2 * m_pairs]`` edge stream through HBM. But everything between the
+state vector and the per-class reduce is *copies*: plan chains route
+values untouched, the class expand broadcasts them, and the realmask
+multiplies by exactly 1.0 on every slot that survives to a reduce input
+(non-real reduce slots read exact ``+0.0`` out of the final pass's
+don't-care handling). A composition of copies is one gather — so the
+whole expand→route chain collapses at build time into a single int32
+source map and the round becomes:
+
+  1. gather   : ``pre[j] = x_pad[src_pre[j]]`` — one bucketed Pallas
+                pass producing the reduce input directly (bitwise equal
+                to the routed path's ``f``: real slots are exact copies
+                of ``xs[u]``/``xw[u]``, everything else reads the
+                appended zero slot)
+  2. reduce   : the *identical* :mod:`~gossipprotocol_tpu.ops.classops`
+                fold kernels the routed path runs — same values, same
+                fold trees, bitwise-identical packed outputs
+  3. gather   : ``nat[i] = y_pad[src_out[i]]`` — class order back to
+                natural order, degree-0 nodes reading the zero slot
+
+which is why ``--delivery pallas`` is held to bitwise equality with
+``--delivery routed`` (tests/test_pallasdelivery.py pins it on every
+topology family at d=1 and d=32): the only arithmetic in either path is
+the shared fold kernels. The build also skips the radix plan compiler
+entirely — composing the maps is O(E) numpy against the routed build's
+chained-plan compilation.
+
+Bucketing. Each gather runs as a ``pl.pallas_call`` over destination
+tiles (8 sublanes x 128 lanes). Two modes, chosen per gather at build
+time by source size:
+
+  * ``resident`` — the source vector fits the VMEM budget: it rides in
+    whole as a single block (same block index every grid step, so Mosaic
+    keeps it resident) and each tile is one ``jnp.take``.
+  * ``bucket``   — big sources (10M nodes: 80 MB state, far past VMEM):
+    plan build sorts each destination tile's source *rows* into a
+    per-tile bucket table (``[tiles, R]``, R the max distinct rows,
+    SMEM-resident per step) and rewrites indices to be slab-local. The
+    kernel DMAs exactly the bucket's rows into a ``[R, 128]`` VMEM
+    scratch slab — contiguous 512 B row copies instead of scattered
+    element gathers — then gathers lane-locally.
+
+Both modes run under ``interpret=True`` on CPU (tier-1 executes the same
+kernels through the Pallas interpreter, including the DMA staging).
+
+The sharded half lives in :func:`pallas_exchange`: the push design's
+monolithic ``jax.lax.all_to_all`` edge-share exchange replaced by
+per-destination-shard ``pltpu.make_async_remote_copy`` under
+``shard_map`` — each shard pushes its outgoing block straight into its
+slot on the destination and waits only on its OWN arrivals (DMA
+semaphores), not on a global collective barrier. Off-TPU the exchange
+falls back to ``all_to_all`` (pure data movement, bitwise-identical
+slabs), which is how the 2/4/8-shard CPU equality tests pin the path.
+
+Fault legality is inherited from the routed delivery unchanged: exact
+under ``all_alive`` / ``targets_alive`` and the component-closed general
+dead-set path, rejected for per-edge loss windows (RunConfig enforces).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gossipprotocol_tpu.ops import plan as plan_mod
+from gossipprotocol_tpu.ops.delivery import (
+    RoutedConfigError, class_layout, class_order, degree_classes,
+)
+from gossipprotocol_tpu.topology.base import Topology
+
+LANES = 128
+TILE_ROWS = 8              # one gather tile: (8, 128) f32, the Mosaic minimum
+TILE = TILE_ROWS * LANES
+
+# sources at or under this many 128-lane rows stay VMEM-resident in the
+# gather kernel (4 MB f32 at the default — comfortably inside the ~16 MB
+# VMEM budget next to the tile stream); larger sources use the bucketed
+# DMA-staging mode. Env-overridable for tests and odd-sized parts.
+RESIDENT_ROWS_DEFAULT = 8192
+
+
+def _resident_rows() -> int:
+    return int(os.environ.get("GOSSIP_TPU_PALLAS_RESIDENT_ROWS",
+                              RESIDENT_ROWS_DEFAULT))
+
+
+def _ceil_to(x: int, q: int) -> int:
+    return -(-int(x) // q) * q
+
+
+# ---- gather kernels ------------------------------------------------------
+
+def _gather_resident_kernel(x_ref, idx_ref, o_ref):
+    flat = x_ref[...].reshape(-1)
+    o_ref[...] = jnp.take(flat, idx_ref[...], axis=None)
+
+
+def _gather_resident(x2d: jax.Array, idx: jax.Array,
+                     interpret: bool) -> jax.Array:
+    """``[T, 8, 128]`` gather with the whole source block VMEM-resident."""
+    tiles = idx.shape[0]
+    return pl.pallas_call(
+        _gather_resident_kernel,
+        grid=(tiles,),
+        in_specs=[
+            pl.BlockSpec(x2d.shape, lambda t: (0, 0)),
+            pl.BlockSpec((1, TILE_ROWS, LANES), lambda t: (t, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, TILE_ROWS, LANES), lambda t: (t, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (tiles, TILE_ROWS, LANES), jnp.float32),
+        interpret=interpret,
+    )(x2d, idx)
+
+
+def _gather_bucket_kernel(rows_ref, x_hbm, lidx_ref, o_ref, slab, sem):
+    r_cap = slab.shape[0]
+
+    def stage(i, _):
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(rows_ref[0, i], 1), :],
+            slab.at[pl.ds(i, 1), :],
+            sem,
+        )
+        cp.start()
+        cp.wait()
+        return 0
+
+    jax.lax.fori_loop(0, r_cap, stage, 0)
+    flat = slab[...].reshape(-1)
+    o_ref[...] = jnp.take(flat, lidx_ref[...], axis=None)
+
+
+def _gather_bucket(x2d: jax.Array, rows: jax.Array, lidx: jax.Array,
+                   interpret: bool) -> jax.Array:
+    """Bucketed gather: stage each tile's source rows into VMEM, then
+    gather slab-locally. ``rows``: int32 [tiles, R] bucket row table
+    (SMEM); ``lidx``: int32 [tiles, 8, 128] slab-local indices."""
+    tiles, r_cap = rows.shape
+    return pl.pallas_call(
+        _gather_bucket_kernel,
+        grid=(tiles,),
+        in_specs=[
+            pl.BlockSpec((1, r_cap), lambda t: (t, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((1, TILE_ROWS, LANES), lambda t: (t, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, TILE_ROWS, LANES), lambda t: (t, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (tiles, TILE_ROWS, LANES), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((r_cap, LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(rows, x2d, lidx)
+
+
+class GatherPlan(NamedTuple):  # registered below: geometry static
+    """One composed copy-chain as a bucketed tile gather.
+
+    ``mode == 'resident'`` carries global indices (``idx``); ``'bucket'``
+    carries the per-tile source-row table plus slab-local indices. The
+    unused arrays are empty (pytrees must keep a fixed leaf structure
+    across cache load / device put)."""
+
+    mode: str                 # 'resident' | 'bucket'
+    src_rows: int             # rows of the padded 2-D source view
+    out_len: int              # valid f32 prefix of the gathered stream
+    idx: jax.Array            # int32 [tiles, 8, 128] (resident) or [0]
+    rows: jax.Array           # int32 [tiles, R] (bucket) or [0]
+    lidx: jax.Array           # int32 [tiles, 8, 128] (bucket) or [0]
+
+    def gather(self, flat: jax.Array, interpret: bool) -> jax.Array:
+        """``out[j] = flat_padded[src[j]]`` for the composed map; input
+        is the unpadded source stream, output the valid prefix."""
+        x2d = jnp.pad(
+            flat, (0, self.src_rows * LANES - flat.shape[0])
+        ).reshape(self.src_rows, LANES)
+        if self.mode == "resident":
+            out = _gather_resident(x2d, self.idx, interpret)
+        else:
+            out = _gather_bucket(x2d, self.rows, self.lidx, interpret)
+        return out.reshape(-1)[: self.out_len]
+
+
+def _register_gather_plan():
+    def flatten(g):
+        return ((g.idx, g.rows, g.lidx), (g.mode, g.src_rows, g.out_len))
+
+    def unflatten(aux, children):
+        return GatherPlan(aux[0], aux[1], aux[2], *children)
+
+    jax.tree_util.register_pytree_node(GatherPlan, flatten, unflatten)
+
+
+_register_gather_plan()
+
+
+def build_gather_plan(src: np.ndarray, src_len: int,
+                      resident_rows: Optional[int] = None) -> GatherPlan:
+    """Compile a composed int64 source map into a :class:`GatherPlan`.
+
+    ``src[j] in [0, src_len]`` — index ``src_len`` (and anything past it
+    up to the row padding) reads an exact ``+0.0`` zero slot, which is
+    how don't-care destinations (class pads, degree-0 nodes, tile
+    padding) match the routed path's final-pass zeros.
+    """
+    out_len = len(src)
+    resident = _resident_rows() if resident_rows is None else resident_rows
+    src_rows = _ceil_to(src_len + 1, TILE_ROWS * LANES) // LANES
+    tiles = _ceil_to(out_len, TILE) // TILE
+    idx = np.full(tiles * TILE, src_len, np.int64)
+    idx[:out_len] = src
+    idx3 = idx.reshape(tiles, TILE_ROWS, LANES).astype(np.int32)
+    empty = np.zeros(0, np.int32)
+    if src_rows <= resident:
+        return GatherPlan("resident", src_rows, out_len,
+                          idx3, empty, empty)
+    # bucket mode: per destination tile, the sorted distinct source rows
+    # (the slabs the kernel DMAs) and slab-local indices into them
+    r = (idx // LANES).reshape(tiles, TILE)
+    order = np.argsort(r, axis=1, kind="stable")
+    sr = np.take_along_axis(r, order, axis=1)
+    new = np.concatenate(
+        [np.ones((tiles, 1), bool), sr[:, 1:] != sr[:, :-1]], axis=1)
+    pos_sorted = np.cumsum(new, axis=1) - 1
+    r_cap = max(TILE_ROWS, _ceil_to(int(new.sum(axis=1).max()), TILE_ROWS))
+    rows_tab = np.zeros((tiles, r_cap), np.int64)
+    t_ids = np.repeat(np.arange(tiles), TILE)
+    rows_tab[t_ids, pos_sorted.reshape(-1)] = sr.reshape(-1)
+    pos = np.empty_like(pos_sorted)
+    np.put_along_axis(pos, order, pos_sorted, axis=1)
+    lidx = (pos * LANES + (idx % LANES).reshape(tiles, TILE)).reshape(
+        tiles, TILE_ROWS, LANES)
+    return GatherPlan("bucket", src_rows, out_len, empty,
+                      rows_tab.astype(np.int32), lidx.astype(np.int32))
+
+
+# ---- the delivery --------------------------------------------------------
+
+class PallasDelivery(NamedTuple):  # registered below: geometry static
+    """Fused Pallas delivery for one topology (a pytree).
+
+    Same ``matvec``/``degree`` surface as
+    :class:`~gossipprotocol_tpu.ops.delivery.RoutedDelivery`, so the
+    routed round functions (``pushsum_diffusion_round_routed``, the
+    counter recounts, ``matvec_payload`` vector payloads) take it
+    unchanged — selecting ``--delivery pallas`` swaps the pytree, not
+    the program structure around it.
+    """
+
+    n: int                        # real nodes
+    nu: int                       # nodes with degree > 0
+    m_pairs: int                  # class-layout pair slots (aligned)
+    # (c, n_c, start_pair, region_rows, node_capacity) per class
+    classes: Tuple[Tuple[int, int, int, int, int], ...]
+    gather_pre: GatherPlan        # [xs|xw|0] -> reduce input (== routed f)
+    gather_out: GatherPlan        # packed class outputs -> [s|w] natural
+    degree: jax.Array             # int32 [n]
+
+    def matvec(self, xs: jax.Array, xw: jax.Array, interpret: bool = False):
+        """(in_s, in_w)[i] = sum over neighbors j of (xs, xw)[j] —
+        bitwise equal to ``RoutedDelivery.matvec`` on the same topology
+        (same reduce kernels over the same f32 values)."""
+        from gossipprotocol_tpu.ops import classops as co
+
+        rows = xs.shape[0]
+        flat = jnp.concatenate([xs[: self.n], xw[: self.n]])
+        f = self.gather_pre.gather(flat, interpret)
+        ys = []
+        for c, n_c, start, reg_rows, cap in self.classes:
+            region = jax.lax.dynamic_slice_in_dim(
+                f, 2 * start, reg_rows * LANES)
+            if 2 * c <= 128:
+                packed = co.class_reduce_small(region, c, interpret)
+            else:
+                packed = co.class_reduce_big(region, c, interpret)
+            ys.append(packed[: 2 * n_c])
+        yf = jnp.concatenate(ys) if ys else jnp.zeros(0, jnp.float32)
+        nat = self.gather_out.gather(yf, interpret)
+        out_s = jnp.pad(nat[: self.n], (0, rows - self.n))
+        out_w = jnp.pad(nat[self.n:], (0, rows - self.n))
+        return out_s, out_w
+
+
+def _register_delivery():
+    def flatten(r):
+        return ((r.gather_pre, r.gather_out, r.degree),
+                (r.n, r.nu, r.m_pairs, r.classes))
+
+    def unflatten(aux, children):
+        return PallasDelivery(aux[0], aux[1], aux[2], aux[3], *children)
+
+    jax.tree_util.register_pytree_node(PallasDelivery, flatten, unflatten)
+
+
+_register_delivery()
+
+
+def pallas_streamed_bytes_per_round(pd: PallasDelivery) -> int:
+    """HBM bytes one matvec streams through the gather tiles: int32
+    indices in, f32 reduce input out, f32 packed outputs re-gathered —
+    the single-pass figure the telemetry manifest records against the
+    routed path's six-pass ``2 * m_pairs * 4`` per pass."""
+    per_slot = 4 + 4                     # idx read + gathered f32 write
+    pre = 2 * int(pd.m_pairs) * per_slot
+    out = 2 * int(pd.n) * per_slot
+    if pd.gather_pre.mode == "bucket":
+        pre += int(pd.gather_pre.rows.size) * (4 + LANES * 4)
+    if pd.gather_out.mode == "bucket":
+        out += int(pd.gather_out.rows.size) * (4 + LANES * 4)
+    return pre + out
+
+
+def pallas_vmem_scratch_bytes(pd: PallasDelivery) -> int:
+    """Peak per-step VMEM the gather kernels hold beyond the tile
+    stream: the resident source block, or the bucketed ``[R, 128]``
+    staging slab — the figure obs/capacity.py's pallas model mirrors."""
+    def one(g: GatherPlan) -> int:
+        if g.mode == "resident":
+            return g.src_rows * LANES * 4
+        return int(g.rows.shape[1]) * LANES * 4 if g.rows.ndim == 2 else 0
+
+    return max(one(pd.gather_pre), one(pd.gather_out))
+
+
+def to_device(pd: PallasDelivery) -> PallasDelivery:
+    """One-time upload of a host-built (or cache-loaded) delivery via
+    ``chunked_put`` (same transfer budget story as the routed upload)."""
+    from gossipprotocol_tpu.protocols.sampling import chunked_put
+
+    return jax.tree.map(chunked_put, pd)
+
+
+def build_pallas_delivery(topo: Topology, progress=None,
+                          device: bool = True,
+                          resident_rows: Optional[int] = None
+                          ) -> PallasDelivery:
+    """Compose the routed pipeline's copy chain into the two gather maps.
+
+    Shares every geometry decision with
+    :func:`~gossipprotocol_tpu.ops.delivery.build_routed_delivery`
+    (degree classes, the load-bearing within-class shuffle, the
+    Pallas-aligned class layout) so the reduce regions — the only
+    arithmetic — are identical, but skips the radix plan compiler: the
+    composed maps are direct O(E) numpy off the canonical CSR.
+    """
+    if topo.implicit_full:
+        raise RoutedConfigError(
+            "pallas delivery: complete graph needs no edges "
+            "(diffusion mixes in one round via reductions)")
+    if topo.asymmetric:
+        raise RoutedConfigError(
+            "pallas delivery: the edge-permutation pairing needs a "
+            "symmetric simple graph; this reference-quirks topology "
+            "carries directed/self/duplicate entries — use "
+            "delivery='scatter'")
+    n = topo.num_nodes
+    offsets = np.asarray(topo.offsets, np.int64)
+    indices = np.asarray(topo.indices, np.int64)
+    degree = np.diff(offsets)
+    cls = degree_classes(degree)
+    order, rank, nu = class_order(cls, n)
+    classes, node_start_pair, m_pairs, _ = class_layout(cls[order])
+    if progress:
+        progress(f"pallas delivery: n={n} nu={nu} m_pairs={m_pairs} "
+                 f"classes={[(c, k) for c, k, *_ in classes]}")
+
+    # reduce-input slot of every directed edge u->v: position of the
+    # reverse edge v->u in v's run — identical pairing math to the
+    # routed build (same canonical-CSR precondition, rechecked)
+    src_nodes = np.repeat(np.arange(n, dtype=np.int64), degree)
+    if len(indices) and not bool(
+            (np.diff(src_nodes * np.int64(n) + indices) > 0).all()):
+        raise ValueError(
+            "pallas delivery requires canonical CSR rows (sorted, "
+            "deduplicated neighbors) — build the topology via "
+            "csr_from_edges")
+    rev = plan_mod.argsort_pairs(indices, src_nodes, n)
+    reverse_of = np.empty(len(indices), np.int64)
+    reverse_of[np.arange(len(indices), dtype=np.int64)] = rev
+    in_rank = np.empty(len(indices), np.int64)
+    in_rank[reverse_of] = np.arange(len(indices)) - offsets[src_nodes]
+    f_slot = node_start_pair[rank[indices]] + in_rank
+
+    # the composed pre-reduce map: reduce pair slot f_slot[e] holds the
+    # share of edge source u — lane 0 reads xs[u] (flat slot u), lane 1
+    # xw[u] (flat slot n + u); every other slot reads the zero slot
+    owner = np.full(m_pairs, -1, np.int64)
+    owner[f_slot] = src_nodes
+    zero_slot = 2 * n
+    src_pre = np.empty(2 * m_pairs, np.int64)
+    real = owner >= 0
+    src_pre[0::2] = np.where(real, owner, zero_slot)
+    src_pre[1::2] = np.where(real, n + owner, zero_slot)
+
+    # the composed output map: dense class-ordered node r packs to
+    # (2r, 2r+1) in the concatenated reduce outputs; degree-0 nodes
+    # read the zero slot (routed's plan_out don't-care zeros)
+    zero_y = 2 * nu
+    src_out = np.full(2 * n, zero_y, np.int64)
+    has = degree > 0
+    src_out[:n][has] = 2 * rank[has]
+    src_out[n:][has] = 2 * rank[has] + 1
+
+    pd = PallasDelivery(
+        n=n, nu=nu, m_pairs=m_pairs, classes=classes,
+        gather_pre=build_gather_plan(src_pre, 2 * n, resident_rows),
+        gather_out=build_gather_plan(src_out, 2 * nu, resident_rows),
+        degree=np.asarray(degree, np.int32),
+    )
+    return to_device(pd) if device else pd
+
+
+# ---- sharded edge-share exchange ----------------------------------------
+
+def _exchange_kernel(me_ref, slab_ref, out_ref, send_sem, recv_sem):
+    num_shards = slab_ref.shape[0]
+    me = me_ref[0]
+    copies = []
+    for d in range(num_shards):
+        # push block d of MY slab into row `me` of shard d's output —
+        # each destination copy streams independently; a shard waits
+        # only for its own arrivals, not a global collective barrier
+        rc = pltpu.make_async_remote_copy(
+            src_ref=slab_ref.at[pl.ds(d, 1)],
+            dst_ref=out_ref.at[pl.ds(me, 1)],
+            send_sem=send_sem.at[d],
+            recv_sem=recv_sem.at[d],
+            device_id=(d,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rc.start()
+        copies.append(rc)
+    for rc in copies:
+        rc.wait()
+
+
+def pallas_exchange(slab: jax.Array, *, axis_name: str,
+                    interpret: bool = False) -> jax.Array:
+    """Push-design edge-share exchange as per-destination async remote
+    copies: ``out[src] on shard dst = slab[dst] on shard src`` — the
+    same ``[num_shards, block]`` permutation as the monolithic
+    ``jax.lax.all_to_all`` it replaces, so the slabs (and therefore the
+    trajectories) are bitwise identical either way.
+
+    Must run under ``shard_map`` on the mesh axis ``axis_name``. Off-TPU
+    (the CPU test mesh, interpret mode) the remote-DMA primitives have
+    no transport, so the exchange degrades to the ``all_to_all``
+    spelling — data-identical, which is what lets the 2/4/8-shard
+    equality tests pin this path on CPU.
+    """
+    if interpret:
+        return jax.lax.all_to_all(
+            slab, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    num_shards, block = slab.shape
+    me = jax.lax.axis_index(axis_name).astype(jnp.int32).reshape(1)
+    return pl.pallas_call(
+        _exchange_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct((num_shards, block), slab.dtype),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((num_shards,)),
+            pltpu.SemaphoreType.DMA((num_shards,)),
+        ],
+        compiler_params=pltpu.TPUCompilerParams(
+            has_side_effects=True, collective_id=0),
+        interpret=interpret,
+    )(me, slab)

@@ -245,6 +245,120 @@ def routed_delivery_cached(topo, cache_dir: Optional[str] = None,
     return (to_device(rd) if device else rd), "miss"
 
 
+# ---- pallas (fused gather) delivery -------------------------------------
+
+def pallas_entry_path(cache_dir: str, key: str) -> str:
+    # the "routed" prefix keeps the entry under _evict_over_budget's
+    # family filter; same content address as the routed entry (the
+    # composed maps are a pure function of the same adjacency)
+    return os.path.join(cache_dir, f"routedpl_v{FORMAT_VERSION}_{key}.npz")
+
+
+def _pack_gather(prefix: str, g, meta: dict, arrays: dict) -> None:
+    meta[prefix] = {"mode": g.mode, "src_rows": g.src_rows,
+                    "out_len": g.out_len}
+    arrays[f"{prefix}.idx"] = np.asarray(g.idx)
+    arrays[f"{prefix}.rows"] = np.asarray(g.rows)
+    arrays[f"{prefix}.lidx"] = np.asarray(g.lidx)
+
+
+def _unpack_gather(prefix: str, meta: dict, z):
+    from gossipprotocol_tpu.ops.pallasdelivery import GatherPlan
+
+    m = meta[prefix]
+    return GatherPlan(m["mode"], m["src_rows"], m["out_len"],
+                      z[f"{prefix}.idx"], z[f"{prefix}.rows"],
+                      z[f"{prefix}.lidx"])
+
+
+def save_pallas(pd, path: str, provenance: Optional[dict] = None) -> None:
+    """Serialize a HOST-side pallas delivery (numpy leaves)."""
+    arrays: dict = {"degree": np.asarray(pd.degree, np.int32)}
+    meta: dict = {
+        "format": FORMAT_VERSION,
+        "n": pd.n, "nu": pd.nu, "m_pairs": pd.m_pairs,
+        "classes": [list(c) for c in pd.classes],
+    }
+    if provenance:
+        meta["provenance"] = provenance
+    _pack_gather("gather_pre", pd.gather_pre, meta, arrays)
+    _pack_gather("gather_out", pd.gather_out, meta, arrays)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}.npz"
+    try:
+        np.savez(tmp, __meta__=json.dumps(meta), **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_pallas(path: str):
+    """Host-side pallas delivery from a cache entry, or None."""
+    from gossipprotocol_tpu.ops.pallasdelivery import PallasDelivery
+
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+            if meta.get("format") != FORMAT_VERSION:
+                return None
+            try:
+                os.utime(path)  # LRU signal for _evict_over_budget
+            except OSError:
+                pass
+            return PallasDelivery(
+                n=meta["n"], nu=meta["nu"], m_pairs=meta["m_pairs"],
+                classes=tuple(tuple(c) for c in meta["classes"]),
+                gather_pre=_unpack_gather("gather_pre", meta, z),
+                gather_out=_unpack_gather("gather_out", meta, z),
+                degree=z["degree"],
+            )
+    except (OSError, ValueError, KeyError, json.JSONDecodeError,
+            zipfile.BadZipFile):
+        return None
+
+
+def pallas_delivery_cached(topo, cache_dir: Optional[str] = None,
+                           progress=None, device: bool = True):
+    """Cache-aware :func:`~gossipprotocol_tpu.ops.pallasdelivery.
+    build_pallas_delivery` — same contract as
+    :func:`routed_delivery_cached`, keyed by the same adjacency digest
+    (its own ``routedpl_v*`` entry family: the composed gather tables,
+    not the radix plans)."""
+    from gossipprotocol_tpu.ops.pallasdelivery import (
+        build_pallas_delivery, to_device as pallas_to_device,
+    )
+
+    cache_dir = cache_dir or default_cache_dir()
+    if cache_dir == "none" or topo.implicit_full:
+        return build_pallas_delivery(topo, progress=progress,
+                                     device=device), "off"
+    path = pallas_entry_path(cache_dir, cache_key(topo))
+    pd = load_pallas(path)
+    if pd is not None:
+        if progress:
+            progress(f"pallas delivery: plan cache hit ({path})"
+                     f"{_provenance_note(path)}")
+        return (pallas_to_device(pd) if device else pd), "hit"
+    t0 = time.perf_counter()
+    pd = build_pallas_delivery(topo, progress=progress, device=False)
+    prov = _provenance(time.perf_counter() - t0, build_workers=1)
+    try:
+        save_pallas(pd, path, provenance=prov)
+        _evict_over_budget(cache_dir, keep=path)
+        if progress:
+            progress(f"pallas delivery: plan cached ({path}); "
+                     f"built in {prov['build_s']}s")
+    except OSError as e:
+        import warnings
+
+        warnings.warn(f"pallas plan cache write failed ({e}); "
+                      "continuing uncached")
+    return (pallas_to_device(pd) if device else pd), "miss"
+
+
 # ---- sharded (directed per-shard) deliveries ---------------------------
 
 def shard_entry_path(cache_dir: str, key: str, n_padded: int,

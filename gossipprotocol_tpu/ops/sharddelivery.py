@@ -615,9 +615,16 @@ class ShardPushDelivery(NamedTuple):  # registered below (geometry aux)
     degree: jax.Array             # int32 [local_n] (full degree)
 
     def matvec(self, xs: jax.Array, xw: jax.Array, *, axis_name: str,
-               interpret: bool = False):
+               interpret: bool = False, exchange: str = "all_to_all"):
         """(in_s, in_w)[local i] = sum over neighbors j of x[j], with
-        ``xs``/``xw`` the LOCAL row slices (no full-state input)."""
+        ``xs``/``xw`` the LOCAL row slices (no full-state input).
+
+        ``exchange``: how the cross-shard slab moves — ``"all_to_all"``
+        (the monolithic collective) or ``"pallas"`` (per-destination
+        ``make_async_remote_copy`` DMAs,
+        :func:`~gossipprotocol_tpu.ops.pallasdelivery.pallas_exchange`).
+        Both move the identical slab, so trajectories are bitwise equal
+        either way."""
         from gossipprotocol_tpu.ops import classops as co
 
         flat = jnp.concatenate([xs[: self.local_n], xw[: self.local_n]])
@@ -642,8 +649,14 @@ class ShardPushDelivery(NamedTuple):  # registered below (geometry aux)
         f_local = out[: 2 * self.m_pairs]
         slab = out[2 * self.m_pairs:].reshape(
             self.num_shards, 2 * self.block_pairs)
-        incoming = jax.lax.all_to_all(
-            slab, axis_name, split_axis=0, concat_axis=0, tiled=True)
+        if exchange == "pallas":
+            from gossipprotocol_tpu.ops.pallasdelivery import pallas_exchange
+
+            incoming = pallas_exchange(slab, axis_name=axis_name,
+                                       interpret=interpret)
+        else:
+            incoming = jax.lax.all_to_all(
+                slab, axis_name, split_axis=0, concat_axis=0, tiled=True)
         # every real f slot reads from exactly one source: its own
         # f_local slot (intra-shard) or its incoming block slot (cross)
         f = _apply_chain(self.plan_recv,
@@ -1176,10 +1189,14 @@ def pushsum_diffusion_round_routed_push(
     interpret: bool = False,
     all_sum,
     axis_name: str,
+    exchange: str = "all_to_all",
 ):
     """Sharded fanout-all round, PUSH design: expand owned rows, one
-    ``all_to_all`` of cross-shard edge shares (2·E/S·4 B per shard — no
+    edge-share exchange of cross-shard shares (2·E/S·4 B per shard — no
     full-state ``all_gather`` anywhere in the round), reduce locally.
+    ``exchange`` picks the transport (``"all_to_all"`` collective, or
+    ``"pallas"`` per-destination async remote copies — bitwise-equal
+    slabs, see :meth:`ShardPushDelivery.matvec`).
     Mathematics and legality identical to the single-chip
     :func:`~gossipprotocol_tpu.protocols.diffusion.
     pushsum_diffusion_round_routed`; the trajectory is bitwise equal to
@@ -1206,7 +1223,7 @@ def pushsum_diffusion_round_routed_push(
         share_w = jnp.where(state.alive, share_w, 0)
     in_s, in_w = matvec_payload(
         lambda a, b: rd.matvec(a, b, axis_name=axis_name,
-                               interpret=interpret),
+                               interpret=interpret, exchange=exchange),
         share_s, share_w,
     )
     if all_alive or targets_alive:
@@ -1215,7 +1232,7 @@ def pushsum_diffusion_round_routed_push(
     else:
         alive_f = state.alive.astype(dt)
         live_deg, _ = rd.matvec(alive_f, alive_f, axis_name=axis_name,
-                                interpret=interpret)
+                                interpret=interpret, exchange=exchange)
         in_s = jnp.where(rowmask(state.alive, in_s), in_s, 0)
         in_w = jnp.where(state.alive, in_w, 0)
         sent_s = share_s * rowmask(live_deg, share_s)

@@ -155,7 +155,7 @@ def _sharded_core(
             edge_chunks=cfg.edge_chunks,
         )
     if cfg.fanout == "all":
-        if cfg.delivery == "routed":
+        if cfg.delivery in ("routed", "pallas"):
             # Sharded-routed delivery (the designs measured in
             # artifacts/sharded_routed_assessment.json), both with
             # per-shard plans whose capacities are forced to cross-shard
@@ -171,9 +171,17 @@ def _sharded_core(
                 pushsum_diffusion_round_routed_sharded,
             )
 
+            push = cfg.routed_design == "push"
+            kw = {}
+            if push:
+                # delivery='pallas' swaps the push exchange transport to
+                # per-destination async remote copies (pallasdelivery.
+                # pallas_exchange) — RunConfig rejects pallas+pull
+                kw["exchange"] = ("pallas" if cfg.delivery == "pallas"
+                                  else "all_to_all")
             return partial(
                 pushsum_diffusion_round_routed_push
-                if cfg.routed_design == "push"
+                if push
                 else pushsum_diffusion_round_routed_sharded,
                 n=n,
                 eps=cfg.eps,
@@ -185,6 +193,7 @@ def _sharded_core(
                 targets_alive=targets_alive,
                 interpret=(platform != "tpu"),
                 axis_name=NODES_AXIS,
+                **kw,
             )
         return wrap_workload(partial(
             pushsum_diffusion_round_core,
@@ -312,7 +321,8 @@ def make_sharded_chunk_runner(
         platform=platform,
     )
     is_pushsum = cfg.algorithm != "gossip"
-    routed = is_pushsum and cfg.fanout == "all" and cfg.delivery == "routed"
+    routed = (is_pushsum and cfg.fanout == "all"
+              and cfg.delivery in ("routed", "pallas"))
     psum_all = lambda x: jax.lax.psum(jnp.sum(x, axis=0), NODES_AXIS)  # noqa: E731
     counter_fn = None
     if tel.counters_on:
@@ -757,7 +767,8 @@ def run_simulation_sharded(
         )
 
     is_pushsum = cfg.algorithm != "gossip"
-    routed = is_pushsum and cfg.fanout == "all" and cfg.delivery == "routed"
+    routed = (is_pushsum and cfg.fanout == "all"
+              and cfg.delivery in ("routed", "pallas"))
     routed_push = routed and cfg.routed_design == "push"
     tel = as_telemetry(cfg.telemetry)
     # counter-buffer rows must cover _drive's chunk sizing, which is
@@ -803,7 +814,7 @@ def run_simulation_sharded(
     with tel.span("jit_compile", engine="sharded"):
         compiled = runner.lower(state, nbrs, seed, jnp.int32(0)).compile()
     tel.record_compiled("chunk", compiled, engine="sharded",
-                        num_shards=num_shards)
+                        num_shards=num_shards, delivery=cfg.delivery)
 
     def step(s, round_limit):
         return compiled(s, nbrs, seed, jnp.int32(round_limit))
@@ -858,7 +869,7 @@ def run_simulation_sharded(
         )
         compiled2 = runner2.lower(st, nbrs2, seed, jnp.int32(0)).compile()
         tel.record_compiled("chunk_rebuild", compiled2, engine="sharded",
-                            num_shards=num_shards)
+                            num_shards=num_shards, delivery=cfg.delivery)
 
         def step2(s, round_limit):
             return compiled2(s, nbrs2, seed, jnp.int32(round_limit))

@@ -55,6 +55,17 @@ _PROGRAM_CASES = {
         algorithm="push-sum", workload="sgp", predicate="global",
         payload_dim=2,
     ),
+    # delivery-path pins (ISSUE 10): the routed digest proves adding the
+    # pallas path left the routed jaxpr byte-unchanged; the pallas digest
+    # pins the new fused-gather program itself
+    "pushsum_routed": dict(
+        algorithm="push-sum", fanout="all", predicate="global",
+        delivery="routed",
+    ),
+    "pushsum_pallas": dict(
+        algorithm="push-sum", fanout="all", predicate="global",
+        delivery="pallas",
+    ),
 }
 
 
@@ -138,6 +149,11 @@ def _program_digests(tmpdir) -> dict:
             ).hexdigest()
             if tel is not None:
                 tel.close()
+    for name in ("pushsum_routed", "pushsum_pallas"):
+        # telemetry-off only: the delivery pins guard the exchange/matvec
+        # program text, the counter variants are covered by the cases above
+        text = _sharded_lowered(_PROGRAM_CASES[name], None)
+        out[f"{name}_2shard_off"] = hashlib.sha256(text.encode()).hexdigest()
     return out
 
 

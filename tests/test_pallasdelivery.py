@@ -1,0 +1,183 @@
+"""Bucketed Pallas delivery (ops/pallasdelivery.py, ISSUE 10): the
+routed pipeline's five copy passes composed at build time into two
+gather maps executed by Pallas kernels, plus the async remote-copy
+edge-share exchange for the sharded push design.
+
+The equivalence bar is BITWISE: the composed gathers feed the very same
+``class_reduce_small/big`` fold trees over the very same f32 values, so
+`--delivery pallas` must reproduce `--delivery routed` bit for bit —
+single chip (both gather-kernel modes), d=1 and d=32 payloads, and
+across 2/4/8 shards where the exchange transport swaps underneath the
+unchanged slab layout."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.obs import Telemetry
+from gossipprotocol_tpu.obs.capacity import estimate_for_topology
+from gossipprotocol_tpu.ops.delivery import (
+    RoutedConfigError,
+    build_routed_delivery,
+)
+from gossipprotocol_tpu.ops.pallasdelivery import (
+    build_pallas_delivery,
+    pallas_streamed_bytes_per_round,
+    pallas_vmem_scratch_bytes,
+)
+from gossipprotocol_tpu.parallel import run_simulation_sharded
+
+# fixed round budget (early stop disabled): the grid compares 24-round
+# trajectories instead of convergence — same bar as test_pushdelivery.py
+_BASE = dict(algorithm="push-sum", fanout="all", predicate="global",
+             tol=1e-4, seed=11, chunk_rounds=8, max_rounds=24,
+             streak_target=2**30)
+
+_TOPOLOGIES = {
+    "line": lambda: build_topology("line", 130),
+    "imp3D": lambda: build_topology("imp3D", 216, seed=4),
+    "powerlaw": lambda: build_topology("powerlaw", 400, seed=3, m=3),
+}
+
+_routed_cache: dict = {}
+
+
+def _routed_run(name, payload_dim=1):
+    """One routed reference trajectory per (topology, d) for the grid."""
+    key = (name, payload_dim)
+    if key not in _routed_cache:
+        topo = _TOPOLOGIES[name]()
+        kw = dict(_BASE, delivery="routed")
+        if payload_dim > 1:
+            kw["payload_dim"] = payload_dim
+        _routed_cache[key] = (topo, run_simulation(topo, RunConfig(**kw)))
+    return _routed_cache[key]
+
+
+def _assert_bitwise(r1, r2):
+    np.testing.assert_array_equal(np.asarray(r1.final_state.s),
+                                  np.asarray(r2.final_state.s))
+    np.testing.assert_array_equal(np.asarray(r1.final_state.w),
+                                  np.asarray(r2.final_state.w))
+
+
+# ------------------------------------------------- single chip, bitwise
+
+
+@pytest.mark.parametrize("name", list(_TOPOLOGIES))
+@pytest.mark.parametrize("payload_dim", [1, 32])
+def test_pallas_bitwise_matches_routed(name, payload_dim):
+    topo, r_rt = _routed_run(name, payload_dim)
+    kw = dict(_BASE, delivery="pallas")
+    if payload_dim > 1:
+        kw["payload_dim"] = payload_dim
+    r_pl = run_simulation(topo, RunConfig(**kw))
+    assert r_rt.rounds == r_pl.rounds == 24
+    _assert_bitwise(r_rt, r_pl)
+
+
+def test_bucket_mode_matvec_bitwise():
+    """Force the DMA-bucketed gather kernel (tiny resident budget) and
+    compare raw matvec outputs against the routed plans — the mode
+    switch must not change a single bit."""
+    import jax.numpy as jnp
+
+    topo = _TOPOLOGIES["powerlaw"]()
+    rd = build_routed_delivery(topo, device=False)
+    pd = build_pallas_delivery(topo, device=False, resident_rows=1)
+    assert pd.gather_pre.mode == "bucket"
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.uniform(size=topo.num_nodes).astype(np.float32))
+    xw = jnp.ones(topo.num_nodes, jnp.float32)
+    ys_r, yw_r = rd.matvec(xs, xw, interpret=True)
+    ys_p, yw_p = pd.matvec(xs, xw, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ys_r), np.asarray(ys_p))
+    np.testing.assert_array_equal(np.asarray(yw_r), np.asarray(yw_p))
+
+
+def test_pallas_rejects_unroutable_configs():
+    """Same loud typed rejections as the routed path, plus the
+    pallas-specific ones (pull design, implicit-full)."""
+    full = build_topology("full", 64)
+    with pytest.raises(RoutedConfigError):
+        build_pallas_delivery(full, device=False)
+    with pytest.raises(ValueError, match="push"):
+        RunConfig(delivery="pallas", routed_design="pull",
+                  algorithm="push-sum", fanout="all", predicate="global")
+
+
+# --------------------------------------------------- sharded, bitwise
+
+
+@pytest.mark.parametrize("num_devices", [2, 4, 8])
+def test_sharded_pallas_bitwise_matches_single_chip(cpu_devices,
+                                                    num_devices):
+    """The async-exchange push path (CPU interpret falls back to the
+    bitwise-identical all_to_all data movement) reproduces the
+    single-chip routed trajectory across shard counts."""
+    topo, r1 = _routed_run("imp3D")
+    rs = run_simulation_sharded(
+        topo, RunConfig(**_BASE, delivery="pallas"),
+        num_devices=num_devices, backend="cpu")
+    assert r1.rounds == rs.rounds == 24
+    _assert_bitwise(r1, rs)
+
+
+# ------------------------------------------------------------ plan cache
+
+
+def test_pallas_plan_cache_roundtrip_bitwise(tmp_path):
+    """A cache hit loads bitwise the gather maps the build produced."""
+    import jax
+
+    from gossipprotocol_tpu.ops import plancache
+
+    topo = build_topology("er", 700, seed=5, avg_degree=6.0)
+    d1, state = plancache.pallas_delivery_cached(
+        topo, cache_dir=str(tmp_path), device=False)
+    assert state == "miss"
+    d2, state2 = plancache.pallas_delivery_cached(
+        topo, cache_dir=str(tmp_path), device=False)
+    assert state2 == "hit"
+    l1, t1 = jax.tree.flatten(d1)
+    l2, t2 = jax.tree.flatten(d2)
+    assert t1 == t2
+    for a, b in zip(l1, l2):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------- capacity model
+
+
+def test_capacity_pallas_tracks_memory_analysis(tmp_path):
+    """The pallas-path argument-bytes model tracks the compiled chunk
+    program's own memory_analysis() on one pinned config, and the VMEM
+    advisory mirrors the kernel's actual scratch shapes."""
+    tel = Telemetry(str(tmp_path / "tel"))
+    topo = build_topology("line", 512, seed=0)
+    cfg = RunConfig(algorithm="push-sum", fanout="all", predicate="global",
+                    delivery="pallas", seed=0, max_rounds=40,
+                    streak_target=2**30, telemetry=tel)
+    run_simulation(topo, cfg)
+    tel.close()
+    from gossipprotocol_tpu.obs.resources import load_resources
+
+    doc = load_resources(str(tmp_path / "tel"))
+    chunk = next(p for p in doc["programs"] if p["label"] == "chunk")
+    assert chunk.get("delivery") == "pallas"
+    actual = chunk["memory"].get("argument_size_in_bytes")
+    if not actual:
+        pytest.skip("memory_analysis reports no argument bytes here")
+    est = estimate_for_topology(topo, cfg, 1)
+    rel = abs(est["argument_bytes"] - actual) / actual
+    assert rel <= 0.35, (
+        f"estimate {est['argument_bytes']} vs measured {actual} "
+        f"({rel:.0%} > 35%) — {est}"
+    )
+
+    pd = build_pallas_delivery(topo, device=False)
+    assert pallas_vmem_scratch_bytes(pd) > 0
+    assert pallas_streamed_bytes_per_round(pd) > 0
