@@ -1,0 +1,26 @@
+"""Asynchronous execution model: deterministic Poisson activation clocks.
+
+The engine's rounds are bulk-synchronous; this package turns "which nodes
+act this round" into a scenario axis. A *clock spec* is a small static
+tuple threaded through the round cores exactly like the fault engine's
+loss windows: ``()`` means the synchronous clock (every node acts, the
+traced program is byte-identical to the pre-async engine), and
+``(rate, id_div)`` means independent Poisson clocks thinned to rounds —
+each round a node is active with probability ``1 - exp(-rate)``, drawn
+counter-based from the run PRNG so trajectories are seed-deterministic
+and sharding-invariant.
+"""
+
+from gossipprotocol_tpu.async_.clock import (
+    CLOCK_FOLD,
+    activation_mask,
+    activation_probability,
+    clock_spec,
+)
+
+__all__ = [
+    "CLOCK_FOLD",
+    "activation_mask",
+    "activation_probability",
+    "clock_spec",
+]

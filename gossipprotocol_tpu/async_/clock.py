@@ -1,0 +1,90 @@
+"""Per-node activation clocks, counter-based like every other draw.
+
+The continuous-time gossip model (arXiv:2011.02379) puts an independent
+rate-``r`` Poisson clock on every node; a node pushes when its clock
+ticks. Discretizing to unit-length rounds thins the process: the number
+of rounds in which node ``i`` is active is Binomial(R, p) with
+``p = 1 - exp(-r)`` — the probability the node's clock ticked at least
+once inside the round. Receivers stay passive (receipt needs no clock),
+which is exactly the paper's single-activation push model.
+
+The activation mask is drawn by the same threefry-on-global-ids pattern
+as the fault engine's loss windows (:func:`protocols.sampling.drop_mask`),
+so the trajectory is a pure function of (seed, round, gid): identical
+under any sharding, reproducible for a fixed seed, and free — the mask
+is a trace-time branch, absent from the compiled program when the clock
+is synchronous.
+
+A clock spec is a static hashable tuple so it can ride jit
+``static_argnames`` next to ``loss_windows``:
+
+* ``()``            — synchronous clock; every node acts every round.
+* ``(rate, id_div)`` — Poisson clock with activation rate ``rate``;
+  activation coins are keyed on ``gid // id_div``. ``id_div = 1`` gives
+  independent per-node clocks; the GALA workload passes the learner
+  group size so a whole group shares one clock and gossips as a unit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu.protocols.sampling import drop_mask
+
+# Domain-separation constant folded into the round key before activation
+# draws. Distinct from sampling.LOSS_FOLD (0x10553) so a run with both
+# packet loss and a Poisson clock draws two independent coin streams —
+# sharing the fold would correlate "my message was dropped" with "my
+# clock ticked" perfectly.
+CLOCK_FOLD = 0xA51C
+
+
+def clock_spec(clock: str, activation_rate: float, id_div: int = 1) -> Tuple:
+    """Build the static clock-spec tuple from config values.
+
+    Raises ``ValueError`` on unknown clock names so config validation has
+    one place that knows the vocabulary.
+    """
+    if clock == "sync":
+        return ()
+    if clock == "poisson":
+        return (float(activation_rate), int(id_div))
+    raise ValueError(f"unknown clock model {clock!r}; use 'sync' or 'poisson'")
+
+
+def activation_probability(clock: Tuple) -> float:
+    """Static per-round activation probability ``1 - exp(-rate)``.
+
+    Returns 1.0 for the synchronous clock. Computed with ``math.exp`` at
+    trace time — the probability is a Python float baked into the program,
+    never a traced value.
+    """
+    if not clock:
+        return 1.0
+    rate = float(clock[0])
+    return 1.0 - math.exp(-rate)
+
+
+def activation_mask(round_key: jax.Array, clock: Tuple,
+                    gids: jax.Array) -> jax.Array:
+    """Bool[rows] — which rows' clocks ticked this round.
+
+    ``round_key`` is the per-round key (already ``fold_in(base_key,
+    round)``); the CLOCK_FOLD domain separation happens here. ``gids``
+    are *global* row ids, so the mask is sharding-invariant. Callers must
+    only invoke this under a poisson spec — the sync path must not trace
+    any of this (the goldens pin the pre-async program text).
+    """
+    assert clock, "activation_mask called under the synchronous clock"
+    p = activation_probability(clock)
+    id_div = int(clock[1])
+    ids = gids if id_div == 1 else gids // jnp.int32(id_div)
+    # drop_mask draws u32 < p·2^32 — reused here as a Bernoulli(p)
+    # sampler where "dropped" means "active"
+    return drop_mask(
+        jax.random.fold_in(round_key, CLOCK_FOLD), jnp.float32(p), ids
+    )

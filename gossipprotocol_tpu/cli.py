@@ -136,6 +136,9 @@ def _build_config(args, algo, fault_schedule, jnp, alert_quorum=None,
         value_mode=args.value_mode,
         payload_dim=args.payload_dim,
         workload=args.workload,
+        clock=args.clock,
+        activation_rate=args.activation_rate,
+        groups=args.groups,
         accel=args.accel,
         accel_lambda=args.accel_lambda,
         lr=args.lr,
@@ -351,9 +354,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "same delivery plans (w stays one weight per node). "
                         "Requires push-sum with intended semantics; "
                         "delivery='invert' is scalar-only")
-    p.add_argument("--workload", choices=["avg", "sgp"], default="avg",
+    p.add_argument("--workload", choices=["avg", "sgp", "gala"],
+                   default="avg",
                    help="what the push-sum payload carries: 'avg' (plain "
-                        "distributed averaging, the default) or 'sgp' — "
+                        "distributed averaging, the default), 'sgp' — "
                         "Stochastic Gradient Push (arXiv:1811.10792): each "
                         "node takes --local-steps gradient steps on its "
                         "private synthetic least-squares shard between "
@@ -361,7 +365,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "distance AND a train-loss plateau. Requires "
                         "push-sum, --predicate global, --delivery scatter; "
                         "prefer --fanout all (single-target receipt dry "
-                        "spells shrink w and destabilize the gradient)")
+                        "spells shrink w and destabilize the gradient) — "
+                        "or 'gala' (arXiv:1906.04585): SGP actor-learners "
+                        "in --groups learner groups, exactly averaged "
+                        "inside each group and mixed between groups by "
+                        "push-sum gossip (pair with --clock poisson for "
+                        "the paper's asynchronous gossip)")
+    p.add_argument("--clock", choices=["sync", "poisson"], default="sync",
+                   help="execution clock: 'sync' (default) activates every "
+                        "node every round — bitwise the pre-async program "
+                        "— while 'poisson' samples each round's senders "
+                        "i.i.d. with P[active] = 1 - exp(-rate) (the "
+                        "thinned continuous-time gossip of "
+                        "arXiv:2011.02379; receivers stay passive). "
+                        "Seed-deterministic and sharding-invariant: masks "
+                        "come from the counter-based run PRNG keyed on "
+                        "global ids, like the fault engine's loss windows. "
+                        "Incompatible with --accel, --semantics reference, "
+                        "and --delivery invert")
+    p.add_argument("--activation-rate", type=_positive_float, default=1.0,
+                   metavar="R",
+                   help="poisson clock rate r > 0: each node's event count "
+                        "over T rounds is Binomial(T, 1 - exp(-r)) — "
+                        "r = 1 activates ~63%% of nodes per round, small r "
+                        "approaches one event per 1/r rounds (ignored "
+                        "under --clock sync)")
+    p.add_argument("--groups", type=_positive_int, default=1, metavar="G",
+                   help="GALA learner-group count (>= 2, must divide the "
+                        "node count; requires --workload gala). Groups "
+                        "share one activation clock under --clock poisson, "
+                        "so a group gossips — or idles — as a unit")
     p.add_argument("--accel", choices=["off", "chebyshev", "epd"],
                    default="off",
                    help="accelerated push-sum averaging for --fanout all "
@@ -691,6 +724,14 @@ def main(argv=None) -> int:
                 f"delivery='{cfg.delivery}' needs an explicit edge list; "
                 "the complete graph has none (diffusion on K_n mixes in "
                 "one round via two reductions) — use delivery='scatter'"
+            )
+        if cfg.workload == "gala" and topo.num_nodes % cfg.groups:
+            # surfaced here so the divisibility failure is a clean CLI
+            # input error (exit 2), not a build-time traceback
+            raise ValueError(
+                f"--workload gala splits {topo.num_nodes} nodes into "
+                f"{cfg.groups} equal groups — the node count must be "
+                "divisible by --groups"
             )
         if (args.devices > 1 and algo == "push-sum"
                 and args.semantics == "reference"):

@@ -119,7 +119,25 @@ class RunConfig:
     workload: str = "avg"          # "avg" (plain averaging) | "sgp"
                                    # (Stochastic Gradient Push on a
                                    # synthetic least-squares shard per
-                                   # node; learn/ package)
+                                   # node; learn/ package) | "gala"
+                                   # (actor-learner groups: local SGP,
+                                   # exact intra-group averaging, async
+                                   # inter-group gossip; learn/gala.py)
+    clock: str = "sync"            # activation clock (async_/ package):
+                                   # "sync" (every node acts every round
+                                   # — the pre-async engine, bitwise) |
+                                   # "poisson" (per-node rate-r Poisson
+                                   # clocks thinned to rounds: a node
+                                   # sends iff its clock ticked).
+                                   # Trajectory field
+    activation_rate: float = 1.0   # poisson clock rate r: per-round
+                                   # activation probability 1 - exp(-r).
+                                   # Trajectory field (ignored when
+                                   # clock='sync')
+    groups: int = 1                # GALA learner-group count G (nodes
+                                   # split into G contiguous id blocks).
+                                   # Trajectory field (1 unless
+                                   # workload='gala')
     accel: str = "off"             # push-sum fanout-all acceleration:
                                    # "off" | "chebyshev" (semi-iterative
                                    # weights, needs a spectral bound) |
@@ -325,8 +343,75 @@ class RunConfig:
                     "and is scalar-payload only; use 'scatter' or 'routed' "
                     "for payload_dim > 1"
                 )
-        if self.workload not in ("avg", "sgp"):
-            raise ValueError("workload must be 'avg' or 'sgp'")
+        if self.workload not in ("avg", "sgp", "gala"):
+            raise ValueError("workload must be 'avg', 'sgp', or 'gala'")
+        if self.clock not in ("sync", "poisson"):
+            raise ValueError("clock must be 'sync' or 'poisson'")
+        if self.activation_rate <= 0:
+            raise ValueError(
+                "activation_rate is a Poisson clock rate and must be > 0"
+            )
+        if self.clock == "poisson":
+            if self.accel != "off":
+                raise ValueError(
+                    "clock='poisson' gates senders per round; the "
+                    "accelerated schemes assume the *fixed* mixing matrix "
+                    "W every iteration — run them under clock='sync'"
+                )
+            if self.semantics == "reference":
+                raise ValueError(
+                    "clock='poisson' models continuous-time activation; "
+                    "semantics='reference' replays the F# baseline's "
+                    "synchronous accident and must stay clock='sync'"
+                )
+            if self.delivery == "invert":
+                raise ValueError(
+                    "delivery='invert' reconstructs deliveries assuming "
+                    "every eligible sender sent; a poisson clock idles "
+                    "senders every round — use delivery='scatter'"
+                )
+        if self.groups < 1:
+            raise ValueError("groups must be >= 1")
+        if self.groups > 1 and self.workload != "gala":
+            raise ValueError(
+                "groups partitions nodes into GALA learner groups; it "
+                "requires workload='gala'"
+            )
+        if self.workload == "gala":
+            if self.groups < 2:
+                raise ValueError(
+                    "workload='gala' needs at least 2 learner groups "
+                    "(groups=1 is plain SGP — use workload='sgp')"
+                )
+            if self.algorithm != "push-sum" or self.semantics == "reference":
+                raise ValueError(
+                    "workload='gala' mixes between groups by push-sum "
+                    "gossip: it requires algorithm='push-sum' with "
+                    "intended semantics"
+                )
+            if self.predicate != "global":
+                raise ValueError(
+                    "workload='gala' certifies inter-group consensus, "
+                    "which is the 'global' predicate"
+                )
+            if self.accel != "off":
+                raise ValueError(
+                    "workload='gala' re-injects mass every round (local "
+                    "gradient steps + group averaging); the accelerated "
+                    "schemes assume a fixed linear iteration"
+                )
+            if self.delivery != "scatter":
+                raise ValueError(
+                    "workload='gala' supports delivery='scatter' (same "
+                    "contract as workload='sgp')"
+                )
+            if sched:
+                raise ValueError(
+                    "workload='gala' keeps groups exactly synchronized "
+                    "by intra-group averaging; fault strikes and loss "
+                    "windows are not modeled for it yet — drop the "
+                    "fault schedule"
+                )
         if self.accel not in ("off", "chebyshev", "epd"):
             raise ValueError("accel must be 'off', 'chebyshev', or 'epd'")
         if self.lr <= 0:
@@ -556,6 +641,10 @@ def build_protocol(
     # touching the alive mask, so a drop-only schedule keeps both flags
     strikes = sched.has_strikes
     loss_windows = sched.static_loss_windows()
+    # () under clock='sync': every round core treats the empty spec as
+    # "trace the literal synchronous program", so sync runs compile to
+    # the byte-identical pre-async jaxpr (pinned by the program goldens)
+    clock = run_clock_spec(topo, cfg)
     all_alive = (
         allow_all_alive and not strikes and alive0 is None and rows == n
     )
@@ -576,7 +665,7 @@ def build_protocol(
         core = partial(
             gossip_round, n=n, threshold=threshold, keep_alive=keep_alive,
             all_alive=all_alive, inverted=gossip_inversion_enabled(topo, cfg),
-            loss_windows=loss_windows,
+            loss_windows=loss_windows, clock=clock,
         )
         done_fn = gossip_done
         extra_stats = lambda s: {  # noqa: E731
@@ -648,6 +737,7 @@ def build_protocol(
                 tol=cfg.tol,
                 all_alive=all_alive,
                 targets_alive=targets_alive,
+                clock=clock,
             )
             if cfg.delivery not in ("routed", "pallas"):
                 # routed runs never carry loss (RunConfig rejects it); the
@@ -732,9 +822,12 @@ def build_protocol(
                 targets_alive=targets_alive,
                 delivery=cfg.delivery,
                 loss_windows=loss_windows,
+                clock=clock,
             )
-        if cfg.workload == "sgp":
-            from gossipprotocol_tpu.learn import make_sgp_core, sgp_init
+        if cfg.workload in ("sgp", "gala"):
+            from gossipprotocol_tpu.learn import (
+                make_gala_core, make_sgp_core, sgp_init,
+            )
 
             # the mixing core above is reused verbatim; only the state
             # swaps (x₀ = 0 plus the loss scalar) and the round gains the
@@ -742,13 +835,28 @@ def build_protocol(
             # rides the nbrs slot — see device_arrays.
             state = sgp_init(
                 rows, cfg.payload_dim, dtype=cfg.dtype, real_nodes=n)
-            core = make_sgp_core(
-                core, lr=cfg.lr, local_steps=cfg.local_steps,
-                loss_tol=cfg.loss_tol,
-            )
+            if cfg.workload == "sgp":
+                core = make_sgp_core(
+                    core, lr=cfg.lr, local_steps=cfg.local_steps,
+                    loss_tol=cfg.loss_tol,
+                )
+            else:
+                # GALA rides the SGP chassis: same state, same bundle,
+                # plus the intra-group exact average before the mix
+                if n % cfg.groups:
+                    raise ValueError(
+                        f"workload='gala' splits {n} nodes into "
+                        f"{cfg.groups} equal groups — nodes must be "
+                        "divisible by groups"
+                    )
+                core = make_gala_core(
+                    core, num_groups=cfg.groups,
+                    group_size=n // cfg.groups, lr=cfg.lr,
+                    local_steps=cfg.local_steps, loss_tol=cfg.loss_tol,
+                )
         done_fn = pushsum_done
         extra_stats = None
-        if cfg.workload == "sgp":
+        if cfg.workload in ("sgp", "gala"):
             extra_stats = lambda s: {"train_loss": s.loss}  # noqa: E731
 
     if alive0 is not None:
@@ -857,7 +965,37 @@ def gossip_inversion_enabled(topo: Topology, cfg: RunConfig) -> bool:
         and not topo.asymmetric
         and os.environ.get("GOSSIP_TPU_INVERT", "1") != "0"
         and use_dense(topo)
+        # inversion reconstructs deliveries from "every spreader sent";
+        # a poisson clock idles spreaders, so the branch is never legal
+        and cfg.clock == "sync"
     )
+
+
+def run_clock_spec(topo: Topology, cfg: RunConfig) -> tuple:
+    """The static activation-clock spec for this run (single source of
+    truth for both engines and the counter/predictor paths).
+
+    ``()`` for the synchronous clock — every round core treats the empty
+    tuple as "trace the literal synchronous program". Under
+    ``clock='poisson'`` the spec is ``(rate, id_div)`` where ``id_div``
+    groups nodes onto one shared clock: 1 normally (independent per-node
+    Poisson processes), the GALA group size for ``workload='gala'`` so a
+    whole learner group gossips — or idles — as one unit.
+    """
+    if cfg.clock == "sync":
+        return ()
+    from gossipprotocol_tpu.async_ import clock_spec
+
+    id_div = 1
+    if cfg.workload == "gala":
+        if topo.num_nodes % cfg.groups:
+            raise ValueError(
+                f"workload='gala' splits {topo.num_nodes} nodes into "
+                f"{cfg.groups} equal groups — nodes must be divisible "
+                "by groups"
+            )
+        id_div = topo.num_nodes // cfg.groups
+    return clock_spec(cfg.clock, cfg.activation_rate, id_div=id_div)
 
 
 def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
@@ -875,10 +1013,12 @@ def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
     pytree — same slot, so the chunk runner and ``shard_map`` specs treat
     data rows exactly like neighbor rows.
     """
-    if cfg.algorithm == "push-sum" and cfg.workload == "sgp":
+    if cfg.algorithm == "push-sum" and cfg.workload in ("sgp", "gala"):
         from gossipprotocol_tpu.learn import SGPBundle, make_least_squares
 
-        inner_cfg = dataclasses.replace(cfg, workload="avg")
+        # groups rides along with the workload: replace both or the
+        # re-run __post_init__ rejects groups>1 without workload='gala'
+        inner_cfg = dataclasses.replace(cfg, workload="avg", groups=1)
         inner = device_arrays(topo, inner_cfg, tel)
         a, b, _ = make_least_squares(
             topo.num_nodes, cfg.payload_dim, cfg.sgp_samples, cfg.seed,

@@ -4,6 +4,7 @@ from gossipprotocol_tpu.learn.data import (
     lsq_node_loss,
     lsq_node_grad,
 )
+from gossipprotocol_tpu.learn.gala import make_gala_core
 from gossipprotocol_tpu.learn.sgp import make_sgp_core, sgp_init
 
 __all__ = [
@@ -11,6 +12,7 @@ __all__ = [
     "make_least_squares",
     "lsq_node_loss",
     "lsq_node_grad",
+    "make_gala_core",
     "make_sgp_core",
     "sgp_init",
 ]

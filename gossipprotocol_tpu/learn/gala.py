@@ -1,0 +1,110 @@
+"""GALA: gossip-based actor-learner groups (arXiv:1906.04585).
+
+GALA organizes learners into G *groups*: inside a group the actor-
+learners share gradients and keep an exactly-synchronized model (the
+paper's learners within one GALA node); *between* groups, models mix
+only through asynchronous push-sum gossip, so no global barrier ever
+forms. One engine round is
+
+    z′    = local SGP gradient step(s) on each node's private shard
+    x_half = x + (z′ − z)                      (same de-bias as learn/sgp)
+    (x̄, w̄) = exact per-group average of (x_half, w) over alive members
+    (x₊₁, w₊₁) = push-sum mixing round of (x̄, w̄)   (inter-group gossip)
+
+The intra-group average is mass-preserving (each alive member gets the
+group mean; the group's Σs, Σw are unchanged), so every push-sum
+invariant — conservation, the global predicate's achievable mean,
+``estimate_error`` — survives. Asynchrony comes from the activation
+clock (:mod:`gossipprotocol_tpu.async_`): the driver builds the poisson
+clock spec with ``id_div = group_size``, so a whole group shares one
+clock and gossips (or stays silent) as a unit — the paper's per-node
+(per-group, in our mapping) asynchronous gossip.
+
+Engine-agnostic like the SGP wrapper: the returned core has the
+``(state, nbrs, key, **kw)`` shape, reuses :class:`~gossipprotocol_tpu.
+learn.data.SGPBundle` on the ``nbrs`` slot and ``SGPState`` (the loss
+scalar rides along), so checkpoints, trace rows, and both engines work
+unmodified. Group membership is by global row id (``gid // group_size``),
+recovered from the engine kwargs (``gids`` on the sharded fanout-one
+path, ``row_offset`` on sharded diffusion, neither single-chip), so the
+grouping — hence the trajectory — is sharding-invariant.
+
+Convergence is SGP's: consensus distance (the mixing core's ``global``
+predicate, now certifying *inter-group* agreement since members are
+exactly equal) AND a plateau of the mean train loss.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu.learn.data import lsq_node_grad, lsq_node_loss
+from gossipprotocol_tpu.protocols.pushsum import sum0
+from gossipprotocol_tpu.protocols.state import SGPState
+
+
+def make_gala_core(mix_core, *, num_groups: int, group_size: int,
+                   lr: float, local_steps: int, loss_tol: float,
+                   all_sum=sum0, group_sum=None):
+    """Wrap a fully-bound push-sum mixing core into a GALA round core.
+
+    ``group_sum(x, group_ids) -> [G, ...]`` is the cross-row group
+    reduction — a plain ``segment_sum`` single-chip (default), a
+    ``psum``'d ``segment_sum`` closure under ``shard_map`` (G is small,
+    so the [G, d] all-reduce is noise next to the round's collectives).
+    Its result must be replicated across shards, like ``all_sum``'s.
+    """
+    if group_sum is None:
+        def group_sum(x, group_ids):
+            return jax.ops.segment_sum(x, group_ids,
+                                       num_segments=num_groups)
+
+    def core(state: SGPState, nbrs, base_key, **kw) -> SGPState:
+        bundle = nbrs  # SGPBundle riding the engine's nbrs slot
+        dt = state.s.dtype
+        step = jnp.asarray(lr, dt)
+        z0 = state.ratio
+        z = z0
+        for _ in range(local_steps):
+            z = z - step * lsq_node_grad(bundle.A, bundle.b, z)
+        live = state.alive[:, None]
+        x_half = state.s + jnp.where(live, z - z0, 0)
+
+        # intra-group exact averaging over alive members: phantom padding
+        # rows (dead, zero mass) must neither receive mass — it would
+        # strand — nor skew the mean, so they are excluded on both sides.
+        # Row ids are global (see module docstring), clipped so padding
+        # rows beyond n fold into the last group as harmless zeros.
+        rows = state.w.shape[0]
+        gid_rows = kw.get("gids")
+        if gid_rows is None:
+            gid_rows = kw.get("row_offset", 0) + jnp.arange(
+                rows, dtype=jnp.int32)
+        group_ids = jnp.clip(
+            gid_rows // jnp.int32(group_size), 0, num_groups - 1)
+        alive_f = state.alive.astype(dt)
+        g_cnt = jnp.maximum(group_sum(alive_f, group_ids),
+                            jnp.asarray(1, dt))                   # [G]
+        g_s = group_sum(jnp.where(live, x_half, 0), group_ids)    # [G, d]
+        g_w = group_sum(jnp.where(state.alive, state.w, 0),
+                        group_ids)                                # [G]
+        x_avg = jnp.where(
+            live, (g_s / g_cnt[:, None])[group_ids], x_half)
+        w_avg = jnp.where(
+            state.alive, (g_w / g_cnt)[group_ids], state.w)
+
+        st = mix_core(state._replace(s=x_avg, w=w_avg),
+                      bundle.nbrs, base_key, **kw)
+
+        node_loss = lsq_node_loss(bundle.A, bundle.b, st.ratio)
+        alive2 = st.alive.astype(dt)
+        mean_loss = (
+            all_sum(jnp.where(st.alive, node_loss, 0))
+            / jnp.maximum(all_sum(alive2), jnp.asarray(1, dt))
+        ).astype(jnp.float32)
+        plateau = jnp.abs(mean_loss - state.loss) <= loss_tol
+        return st._replace(converged=st.converged & plateau,
+                           loss=mean_loss)
+
+    return core

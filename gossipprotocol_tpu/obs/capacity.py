@@ -260,7 +260,7 @@ def estimate_run_bytes(
     # SGP data shards row-wise with the state: A [rows, samples, d] +
     # b [rows, samples]
     data_bytes = 0
-    if cfg.workload == "sgp":
+    if cfg.workload in ("sgp", "gala"):
         data_bytes = local_rows * int(cfg.sgp_samples) * (d + 1) * B
 
     # transient estimate: the delivery scratch XLA materializes inside a
@@ -447,7 +447,8 @@ def main(argv=None) -> int:
     parser.add_argument("--delivery", default=None,
                         choices=["scatter", "invert", "routed", "pallas"])
     parser.add_argument("--payload-dim", type=int, default=1)
-    parser.add_argument("--workload", choices=["avg", "sgp"], default="avg")
+    parser.add_argument("--workload", choices=["avg", "sgp", "gala"],
+                        default="avg")
     parser.add_argument("--sgp-samples", type=int, default=16)
     parser.add_argument("--x64", action="store_true")
     parser.add_argument("--avg-degree", type=float, default=8.0)
@@ -477,6 +478,10 @@ def main(argv=None) -> int:
         )
         if args.workload == "sgp":
             cfg_kw.update(fanout="all", predicate="global")
+        elif args.workload == "gala":
+            # smallest legal GALA shape for sizing: group count does not
+            # change the byte estimate (data/state are per-row)
+            cfg_kw.update(fanout="all", predicate="global", groups=2)
         if args.delivery is not None:
             cfg_kw["delivery"] = args.delivery
         elif args.fanout == "all":

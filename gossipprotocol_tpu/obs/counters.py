@@ -64,6 +64,7 @@ def make_counter_fn(
     all_sum: Optional[Callable] = None,
     interpret: bool = False,
     axis_name: Optional[str] = None,
+    clock_override: Optional[tuple] = None,
 ) -> Callable:
     """Build the per-round counter function for this run's exact branch.
 
@@ -77,20 +78,30 @@ def make_counter_fn(
     """
     n = topo.num_nodes
     loss_windows = cfg.schedule.static_loss_windows()
+    # same spec the round compiled with: counters re-derive the round's
+    # activation draws through the same fold, so counts match senders
+    from gossipprotocol_tpu.engine.driver import run_clock_spec
+
+    clock = (clock_override if clock_override is not None
+             else run_clock_spec(topo, cfg))
     if all_sum is None:
         all_sum = jnp.sum
 
-    if cfg.algorithm == "push-sum" and cfg.workload == "sgp":
-        # SGP rounds are a gradient step wrapped around a plain mixing
+    if cfg.algorithm == "push-sum" and cfg.workload in ("sgp", "gala"):
+        # SGP/GALA rounds are learner steps wrapped around a plain mixing
         # round; the message traffic is exactly the mixing round's, with
         # the delivery pytree riding inside the SGPBundle's nbrs slot —
-        # count through the inner branch after unwrapping
+        # count through the inner branch after unwrapping. GALA's clock
+        # spec (group-level id_div) must survive the unwrap, so the inner
+        # cfg keeps clock/activation_rate and only swaps the workload —
+        # the spec is recomputed here from the *outer* cfg and closed over.
         import dataclasses as _dc
 
         inner = make_counter_fn(
-            topo, _dc.replace(cfg, workload="avg"),
+            topo, _dc.replace(cfg, workload="avg", groups=1),
             all_alive=all_alive, targets_alive=targets_alive,
             all_sum=all_sum, interpret=interpret, axis_name=axis_name,
+            clock_override=clock,
         )
 
         def fn(old, new, bundle, base_key, alive_global, gids):
@@ -109,6 +120,7 @@ def make_counter_fn(
             topo, _dc.replace(cfg, accel="off"),
             all_alive=all_alive, targets_alive=targets_alive,
             all_sum=all_sum, interpret=interpret, axis_name=axis_name,
+            clock_override=clock,
         )
 
     if cfg.algorithm == "gossip":
@@ -121,7 +133,7 @@ def make_counter_fn(
             return gossip_message_counts(
                 old, new, nbrs, base_key, n=n, gids=gids,
                 keep_alive=keep_alive, all_alive=all_alive,
-                loss_windows=loss_windows,
+                loss_windows=loss_windows, clock=clock,
             )
 
         return fn
@@ -151,6 +163,7 @@ def make_counter_fn(
                         old, nbrs, design=cfg.routed_design,
                         axis_name=axis_name, interpret=interpret,
                         fast_alive=fast, all_alive=all_alive,
+                        base_key=base_key, clock=clock,
                     )
 
                 return fn
@@ -163,6 +176,7 @@ def make_counter_fn(
                 return routed_message_counts(
                     old, nbrs, n=n, all_alive=all_alive,
                     targets_alive=targets_alive, interpret=interpret,
+                    base_key=base_key, clock=clock,
                 )
 
             return fn
@@ -175,7 +189,7 @@ def make_counter_fn(
             return diffusion_message_counts(
                 old, nbrs, base_key, n=n, gids=gids, all_alive=all_alive,
                 targets_alive=targets_alive, loss_windows=loss_windows,
-                alive_global=alive_global, all_sum=all_sum,
+                alive_global=alive_global, all_sum=all_sum, clock=clock,
             )
 
         return fn
@@ -187,6 +201,7 @@ def make_counter_fn(
             old, nbrs, base_key, n=n, gids=gids, all_alive=all_alive,
             targets_alive=targets_alive, delivery=cfg.delivery,
             loss_windows=loss_windows, alive_global=alive_global,
+            clock=clock,
         )
 
     return fn

@@ -87,7 +87,23 @@ def predict_rounds(topo, cfg) -> Dict[str, Any]:
         "num_nodes": n,
         "num_edges": edges,
         "budget_factor": BUDGET_FACTOR,
+        "clock": getattr(cfg, "clock", "sync"),
     }
+    # poisson clock: each engine round only a Bernoulli(p) subset of
+    # senders fires (p = 1 − e^{−rate}, the thinned-process activation),
+    # so one *synchronous-equivalent* contraction step takes ~1/p rounds
+    # — the classical continuous-time slowdown (arXiv:2011.02379). Sync
+    # keeps the factor at exactly 1 (bitwise-unchanged prediction doc
+    # modulo the new clock fields).
+    slowdown = 1.0
+    if getattr(cfg, "clock", "sync") == "poisson":
+        from gossipprotocol_tpu.async_ import activation_probability, clock_spec
+
+        p = activation_probability(
+            clock_spec("poisson", cfg.activation_rate))
+        doc["activation_rate"] = float(cfg.activation_rate)
+        doc["activation_probability"] = round(p, 12)
+        slowdown = 1.0 / p
     if cfg.algorithm == "gossip":
         # heuristic, not a bound: O(log n) spread (push-only rumor needs
         # ~log2 n + ln n rounds on an expander), then ~1 hit per node per
@@ -110,6 +126,15 @@ def predict_rounds(topo, cfg) -> Dict[str, Any]:
         doc.update(model="spectral-pushsum", confidence="analytic",
                    gamma=round(gamma, 12),
                    spectral_gap=round(1.0 - gamma, 12), tol=tol_eff)
+    if slowdown != 1.0:
+        predicted = math.ceil(predicted * slowdown)
+    if getattr(cfg, "workload", "avg") in ("sgp", "gala"):
+        # learning workloads stop on consensus AND a loss plateau; the
+        # spectral bound only covers the mixing part, so the prediction
+        # is a lower bound — downgraded so the anomaly engine's
+        # round-blowout rule (analytic-only) never fires on a healthy
+        # training run
+        doc["confidence"] = "heuristic"
     predicted = max(1, int(predicted))
     doc["predicted_rounds"] = predicted
     doc["budget_rounds"] = int(

@@ -292,10 +292,12 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
             f" dropped={counters.get('dropped', 0)}\n"
         )
         drift = manifest.get("max_mass_drift_ulps")
-        # SGP injects mass by design (the gradient step), so a conservation
-        # claim would be meaningless there — the driver never measures it
+        # SGP/GALA inject mass by design (the gradient step), so a
+        # conservation claim would be meaningless there — the driver
+        # never measures it
         if drift is not None and (
-            manifest.get("config", {}).get("workload", "avg") != "sgp"
+            manifest.get("config", {}).get("workload", "avg")
+            not in ("sgp", "gala")
         ):
             out.write(
                 f"push-sum mass drift: |Σs| ≤ {drift:g} ULPs,"

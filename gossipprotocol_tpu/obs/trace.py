@@ -92,7 +92,7 @@ def make_trace_fn(
         from gossipprotocol_tpu.protocols.gossip import gossip_trace_row
 
         return lambda s: gossip_trace_row(s, **kw)
-    if cfg.workload == "sgp":
+    if cfg.workload in ("sgp", "gala"):
         from gossipprotocol_tpu.learn.sgp import sgp_trace_row
 
         return lambda s: sgp_trace_row(s, **kw)

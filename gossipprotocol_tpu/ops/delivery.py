@@ -301,6 +301,33 @@ def matvec_payload(matvec, xs: jax.Array, xw: jax.Array):
     return jnp.stack(outs[:-1], axis=1), outs[-1]
 
 
+def mask_sender_rows(share_s: jax.Array, share_w: jax.Array,
+                     round_key: jax.Array, clock: tuple,
+                     gids: jax.Array):
+    """Zero the outgoing shares of rows whose activation clock did not
+    tick (:mod:`gossipprotocol_tpu.async_`).
+
+    The routing plans are static linear operators — they cannot mask
+    senders per round, and rebuilding them per activation draw would
+    throw away the whole point of caching. But delivery is linear in the
+    shares, so an idle sender is exactly a zeroed input row: the plan,
+    the sent/delivered accounting (``share·deg`` and friends) and mass
+    conservation all compose unchanged. Every routed round (single-chip
+    :func:`protocols.diffusion.pushsum_diffusion_round_routed`, the
+    sharded push/pull variants in :mod:`ops.sharddelivery`) funnels its
+    activation masking through here. ``gids`` must be *global* row ids
+    so the mask is sharding-invariant.
+    """
+    from gossipprotocol_tpu.async_.clock import activation_mask
+
+    active = activation_mask(round_key, clock, gids)
+    row = active if share_s.ndim == 1 else active[:, None]
+    return (
+        jnp.where(row, share_s, 0),
+        jnp.where(active, share_w, 0),
+    )
+
+
 def routed_streamed_bytes_per_round(rd: RoutedDelivery) -> int:
     """Edge-stream f32 bytes one matvec moves through the class layout:
     the interleaved ``[2 * m_pairs]`` slab (both expand output and

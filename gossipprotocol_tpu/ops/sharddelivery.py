@@ -1190,6 +1190,7 @@ def pushsum_diffusion_round_routed_push(
     all_sum,
     axis_name: str,
     exchange: str = "all_to_all",
+    clock: tuple = (),
 ):
     """Sharded fanout-all round, PUSH design: expand owned rows, one
     edge-share exchange of cross-shard shares (2·E/S·4 B per shard — no
@@ -1205,13 +1206,16 @@ def pushsum_diffusion_round_routed_push(
     ``matvec(alive, alive)`` live-degree pass runs the identical
     exchange, so fault strikes stay exact under any device count.
     """
-    from gossipprotocol_tpu.ops.delivery import matvec_payload
+    from gossipprotocol_tpu.ops.delivery import (
+        mask_sender_rows, matvec_payload,
+    )
     from gossipprotocol_tpu.protocols.pushsum import (
         finish_pushsum_round,
         rowmask,
     )
 
-    del base_key  # deterministic: fanout-all draws nothing
+    if not clock:
+        del base_key  # deterministic: fanout-all draws nothing
     rd = jax.tree.map(lambda x: x[0], shard_rd)  # drop the shard axis
     dt = state.s.dtype
     deg = rd.degree.astype(dt)
@@ -1221,6 +1225,12 @@ def pushsum_diffusion_round_routed_push(
     if not all_alive:
         share_s = jnp.where(rowmask(state.alive, share_s), share_s, 0)
         share_w = jnp.where(state.alive, share_w, 0)
+    if clock:
+        share_s, share_w = mask_sender_rows(
+            share_s, share_w,
+            jax.random.fold_in(base_key, state.round), clock,
+            _global_row_ids(state.w.shape[0], axis_name),
+        )
     in_s, in_w = matvec_payload(
         lambda a, b: rd.matvec(a, b, axis_name=axis_name,
                                interpret=interpret, exchange=exchange),
@@ -1245,6 +1255,13 @@ def pushsum_diffusion_round_routed_push(
     )
 
 
+def _global_row_ids(local_n: int, axis_name: str) -> jax.Array:
+    """Global row ids of this shard's block — keys the activation draws
+    so the poisson-clock mask is identical under any device count."""
+    return (jax.lax.axis_index(axis_name) * jnp.int32(local_n)
+            + jnp.arange(local_n, dtype=jnp.int32))
+
+
 def shard_routed_message_counts(
     state,
     shard_rd,  # ShardPushDelivery | ShardRoutedDelivery, [1, ...] slice
@@ -1254,6 +1271,8 @@ def shard_routed_message_counts(
     interpret: bool,
     fast_alive: bool,
     all_alive: bool,
+    base_key=None,
+    clock: tuple = (),
 ) -> jax.Array:
     """Telemetry recount of one sharded routed round: int32 [sent,
     delivered, dropped] over the LOCAL rows (obs/counters.py semantics;
@@ -1268,6 +1287,14 @@ def shard_routed_message_counts(
     """
     rd = jax.tree.map(lambda x: x[0], shard_rd)  # drop the shard axis
     deg = rd.degree.astype(jnp.float32)
+    if clock:
+        from gossipprotocol_tpu.async_.clock import activation_mask
+
+        active = activation_mask(
+            jax.random.fold_in(base_key, state.round), clock,
+            _global_row_ids(state.w.shape[0], axis_name),
+        )
+        deg = jnp.where(active, deg, 0)
     if all_alive:
         sent = _count_i32(jnp.sum(deg))
         return jnp.stack([sent, sent, jnp.int32(0)])
@@ -1282,6 +1309,8 @@ def shard_routed_message_counts(
     else:
         fa = jax.lax.all_gather(alive_f, axis_name, tiled=True)
         live_deg, _ = rd.matvec(fa, fa, interpret=interpret)
+    if clock:
+        live_deg = jnp.where(active, live_deg, 0)
     delivered = _count_i32(
         jnp.sum(jnp.where(state.alive, live_deg, 0))
     )
@@ -1337,6 +1366,7 @@ def pushsum_diffusion_round_routed_sharded(
     interpret: bool = False,
     all_sum,
     axis_name: str,
+    clock: tuple = (),
 ):
     """Sharded fanout-all round with routed delivery: one ``all_gather``
     of the share vectors (2·n·4 B over ICI — the measured-arithmetic
@@ -1347,13 +1377,16 @@ def pushsum_diffusion_round_routed_sharded(
     pushsum_diffusion_round_routed`, including the general-dead-set
     live-degree path (``targets_alive=False``).
     """
-    from gossipprotocol_tpu.ops.delivery import matvec_payload
+    from gossipprotocol_tpu.ops.delivery import (
+        mask_sender_rows, matvec_payload,
+    )
     from gossipprotocol_tpu.protocols.pushsum import (
         finish_pushsum_round,
         rowmask,
     )
 
-    del base_key  # deterministic: fanout-all draws nothing
+    if not clock:
+        del base_key  # deterministic: fanout-all draws nothing
     rd = jax.tree.map(lambda x: x[0], shard_rd)  # drop the shard axis
     dt = state.s.dtype
     deg = rd.degree.astype(dt)
@@ -1363,6 +1396,12 @@ def pushsum_diffusion_round_routed_sharded(
     if not all_alive:
         share_s = jnp.where(rowmask(state.alive, share_s), share_s, 0)
         share_w = jnp.where(state.alive, share_w, 0)
+    if clock:
+        share_s, share_w = mask_sender_rows(
+            share_s, share_w,
+            jax.random.fold_in(base_key, state.round), clock,
+            _global_row_ids(state.w.shape[0], axis_name),
+        )
     fs = jax.lax.all_gather(share_s, axis_name, tiled=True)
     fw = jax.lax.all_gather(share_w, axis_name, tiled=True)
     in_s, in_w = matvec_payload(

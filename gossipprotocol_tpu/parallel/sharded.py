@@ -103,19 +103,44 @@ def _sharded_core(
     # drop masks key on global ids, so the loss windows thread through the
     # sharded cores unchanged — same trajectories as single-chip
     loss_windows = cfg.schedule.static_loss_windows()
+    # activation masks key on global ids too (same drop_mask primitive),
+    # so the poisson clock is sharding-invariant; () = sync traces the
+    # literal synchronous program
+    from gossipprotocol_tpu.engine.driver import run_clock_spec
+
+    clock = run_clock_spec(topo, cfg)
     # node-axis reduction: scalar for 1-D operands (identical jaxpr to the
     # pre-vector full sum), per-dimension [d] for vector payloads
     all_sum = lambda x: jax.lax.psum(jnp.sum(x, axis=0), NODES_AXIS)  # noqa: E731
 
     def wrap_workload(core):
-        if cfg.workload != "sgp":
-            return core
-        from gossipprotocol_tpu.learn import make_sgp_core
+        if cfg.workload == "sgp":
+            from gossipprotocol_tpu.learn import make_sgp_core
 
-        return make_sgp_core(
-            core, lr=cfg.lr, local_steps=cfg.local_steps,
-            loss_tol=cfg.loss_tol, all_sum=all_sum,
-        )
+            return make_sgp_core(
+                core, lr=cfg.lr, local_steps=cfg.local_steps,
+                loss_tol=cfg.loss_tol, all_sum=all_sum,
+            )
+        if cfg.workload == "gala":
+            from gossipprotocol_tpu.learn import make_gala_core
+
+            def group_sum(x, group_ids):
+                # per-shard partial sums, all-reduced to the replicated
+                # [G, ...] totals the intra-group average needs (G is
+                # small — this collective is noise next to the round's)
+                return jax.lax.psum(
+                    jax.ops.segment_sum(
+                        x, group_ids, num_segments=cfg.groups),
+                    NODES_AXIS,
+                )
+
+            return make_gala_core(
+                core, num_groups=cfg.groups, group_size=n // cfg.groups,
+                lr=cfg.lr, local_steps=cfg.local_steps,
+                loss_tol=cfg.loss_tol, all_sum=all_sum,
+                group_sum=group_sum,
+            )
+        return core
 
     if cfg.algorithm == "gossip":
         from gossipprotocol_tpu.engine.driver import gossip_inversion_enabled
@@ -129,6 +154,7 @@ def _sharded_core(
             inverted=gossip_inversion_enabled(topo, cfg),
             all_sum=all_sum,
             loss_windows=loss_windows,
+            clock=clock,
         )
     if cfg.accel != "off":
         from gossipprotocol_tpu.protocols.accel import (
@@ -193,6 +219,7 @@ def _sharded_core(
                 targets_alive=targets_alive,
                 interpret=(platform != "tpu"),
                 axis_name=NODES_AXIS,
+                clock=clock,
                 **kw,
             )
         return wrap_workload(partial(
@@ -207,6 +234,7 @@ def _sharded_core(
             targets_alive=targets_alive,
             edge_chunks=cfg.edge_chunks,
             loss_windows=loss_windows,
+            clock=clock,
         ))
     if cfg.delivery == "invert":
         raise ValueError(
@@ -235,6 +263,7 @@ def _sharded_core(
         all_alive=all_alive,
         targets_alive=targets_alive,
         loss_windows=loss_windows,
+        clock=clock,
     ))
 
 
@@ -650,8 +679,8 @@ def make_sharded_chunk_runner(
         # SGP wraps the delivery pytree in a bundle; build the bare
         # delivery here and wrap below, so padding/sharding of the
         # neighbor tables stays on this one path
-        inner_cfg = (_dc.replace(cfg, workload="avg")
-                     if cfg.workload == "sgp" else cfg)
+        inner_cfg = (_dc.replace(cfg, workload="avg", groups=1)
+                     if cfg.workload in ("sgp", "gala") else cfg)
         nbrs = pad_neighbors(device_arrays(topo, inner_cfg), n_padded)
         # dense adjacency rows align with the state rows -> shard over
         # "nodes" (each device holds only its own rows); CSR replicates
@@ -660,7 +689,7 @@ def make_sharded_chunk_runner(
     nbrs_specs = jax.tree.map(
         lambda _: P(NODES_AXIS) if nbrs_sharded else P(), nbrs
     )
-    sgp_bundle = is_pushsum and cfg.workload == "sgp"
+    sgp_bundle = is_pushsum and cfg.workload in ("sgp", "gala")
     if sgp_bundle:
         from gossipprotocol_tpu.learn import SGPBundle, make_least_squares
 
@@ -687,7 +716,7 @@ def make_sharded_chunk_runner(
     stats_fields = ["round", "done", "converged", "alive"]
     if cfg.algorithm != "gossip":
         stats_fields += ["ratio_min", "ratio_max", "w_underflow"]
-        if cfg.workload == "sgp":
+        if cfg.workload in ("sgp", "gala"):
             stats_fields += ["train_loss"]
     else:
         stats_fields += ["spreading"]
@@ -695,9 +724,9 @@ def make_sharded_chunk_runner(
         stats_fields += ["counters"]
         if attribution:
             stats_fields += ["shard_counters"]
-        if is_pushsum and cfg.workload != "sgp":
-            # SGP injects mass every round by design; mass_stats returns
-            # nothing for it (see engine.driver.mass_stats)
+        if is_pushsum and cfg.workload not in ("sgp", "gala"):
+            # SGP/GALA inject mass every round by design; mass_stats
+            # returns nothing for them (see engine.driver.mass_stats)
             stats_fields += ["mass_s", "mass_w"]
     if trace_fn is not None:
         stats_fields += ["trace"]

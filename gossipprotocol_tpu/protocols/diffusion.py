@@ -140,6 +140,7 @@ def diffusion_mix(
     edge_chunks: int = 1,
     loss_windows: tuple = (),
     row_offset=0,
+    clock: tuple = (),
 ):
     """The lazy-random-walk mixing step alone: returns
     ``(s_new, w_new, in_w)`` with no predicate applied.
@@ -149,6 +150,14 @@ def diffusion_mix(
     with the previous iterate before running the shared predicate tail.
     Payload-polymorphic: ``state.s`` may be ``[rows]`` or ``[rows, d]``
     (``w`` always per-node); the d=1 trace is the pre-vector program.
+
+    ``clock`` (static; :mod:`gossipprotocol_tpu.async_`) zeroes the
+    outgoing shares of rows whose Poisson clock did not tick — delivery
+    is linear in the shares, so every downstream accounting term
+    (``sent = share·deg``, the delivered-count path, the implicit-full
+    reductions) is automatically exact and mass stays conserved. Unlike
+    per-edge loss, activation is per-*node*, so the implicit complete
+    graph is legal under a poisson clock.
     """
     dt = state.w.dtype
     if loss_windows:
@@ -163,8 +172,20 @@ def diffusion_mix(
             jax.random.fold_in(base_key, state.round), LOSS_FOLD
         )
         p_loss = loss_probability(state.round, loss_windows)
-    else:
+    elif not clock:
         del base_key  # deterministic: fanout-all draws nothing
+
+    if clock:
+        from gossipprotocol_tpu.async_.clock import activation_mask
+
+        gid_rows = row_offset + jnp.arange(
+            state.w.shape[0], dtype=jnp.int32
+        )
+        active = activation_mask(
+            jax.random.fold_in(base_key, state.round), clock, gid_rows
+        )
+    else:
+        active = None
 
     if nbrs is None:
         # Implicit complete graph: in_i = Σ share − share_i. Mixes in one
@@ -180,6 +201,11 @@ def diffusion_mix(
             w_m = jnp.where(state.alive, state.w, 0)
         share_s = s_m / a_count
         share_w = w_m / a_count
+        if active is not None:
+            # an idle node ships nothing; in_i = Σ share − share_i still
+            # holds because its own (zero) share subtracts out
+            share_s = jnp.where(rowmask(active, share_s), share_s, 0)
+            share_w = jnp.where(active, share_w, 0)
         in_s = all_sum(share_s) - share_s
         in_w = all_sum(share_w) - share_w
         sent_s = share_s * (a_count - 1)
@@ -197,6 +223,9 @@ def diffusion_mix(
     if not all_alive:
         share_s = jnp.where(rowmask(state.alive, share_s), share_s, 0)
         share_w = jnp.where(state.alive, share_w, 0)
+    if active is not None:
+        share_s = jnp.where(rowmask(active, share_s), share_s, 0)
+        share_w = jnp.where(active, share_w, 0)
 
     # Delivery, optionally in ``edge_chunks`` sequential slices: the
     # per-edge intermediates (gathered shares, deliver masks) are the
@@ -281,6 +310,7 @@ def pushsum_diffusion_round_core(
     edge_chunks: int = 1,
     loss_windows: tuple = (),
     row_offset=0,
+    clock: tuple = (),
 ) -> PushSumState:
     """One synchronous fanout-all round.
 
@@ -312,6 +342,7 @@ def pushsum_diffusion_round_core(
         edge_chunks=edge_chunks,
         loss_windows=loss_windows,
         row_offset=row_offset,
+        clock=clock,
     )
     return finish_pushsum_round(
         state, s_new, w_new,
@@ -344,6 +375,7 @@ def diffusion_message_counts(
     loss_windows: tuple,
     alive_global,
     all_sum=jnp.sum,
+    clock: tuple = (),
 ) -> jax.Array:
     """Telemetry recount of one fanout-all scatter round: int32 [sent,
     delivered, dropped] over the local rows (obs/counters.py semantics).
@@ -357,14 +389,33 @@ def diffusion_message_counts(
     ``gids`` globalizes the local ``src`` ids under shard_map
     (``row_offset = gids[0]``); None single-chip.
     """
+    if clock:
+        from gossipprotocol_tpu.async_.clock import activation_mask
+
+        gid_rows_c = (
+            gids if gids is not None
+            else jnp.arange(old.w.shape[0], dtype=jnp.int32)
+        )
+        active = activation_mask(
+            jax.random.fold_in(base_key, old.round), clock, gid_rows_c
+        )
+    else:
+        active = None
+
     if nbrs is None:
         dt = old.s.dtype
+        send_rows = old.alive if not all_alive else None
+        if active is not None:
+            send_rows = (active if send_rows is None
+                         else (send_rows & active))
         if all_alive:
-            local = jnp.asarray(old.s.shape[0], jnp.float32)
             a = jnp.asarray(n, jnp.float32)
         else:
-            local = jnp.sum(old.alive.astype(jnp.float32))
             a = all_sum(old.alive.astype(jnp.float32))
+        local = (
+            jnp.asarray(old.s.shape[0], jnp.float32) if send_rows is None
+            else jnp.sum(send_rows.astype(jnp.float32))
+        )
         del dt
         cnt = _clip_count(local * jnp.maximum(a - 1.0, 0.0))
         return jnp.stack([cnt, cnt, jnp.int32(0)])
@@ -373,6 +424,9 @@ def diffusion_message_counts(
     mask = nbrs.valid
     if src_alive is not None:
         mask = src_alive if mask is None else (mask & src_alive)
+    if active is not None:
+        src_active = active[nbrs.src]
+        mask = src_active if mask is None else (mask & src_active)
     sent = (
         jnp.asarray(nbrs.src.shape[0], jnp.int32) if mask is None
         else jnp.sum(mask.astype(jnp.int32))
@@ -414,6 +468,8 @@ def routed_message_counts(
     all_alive: bool,
     targets_alive: bool,
     interpret: bool = False,
+    base_key=None,
+    clock: tuple = (),
 ) -> jax.Array:
     """Telemetry recount of one single-chip routed round (obs/counters.py).
 
@@ -430,6 +486,15 @@ def routed_message_counts(
     deg = routed.degree.astype(dt)
     if rows > n:
         deg = jnp.pad(deg, (0, rows - n))
+    if clock:
+        # only rows whose clock ticked shipped their shares this round
+        from gossipprotocol_tpu.async_.clock import activation_mask
+
+        active = activation_mask(
+            jax.random.fold_in(base_key, old.round), clock,
+            jnp.arange(rows, dtype=jnp.int32),
+        )
+        deg = jnp.where(active, deg, 0)
     if all_alive:
         sent = _clip_count(jnp.sum(deg))
         return jnp.stack([sent, sent, jnp.int32(0)])
@@ -439,6 +504,8 @@ def routed_message_counts(
         return jnp.stack([sent, sent, jnp.int32(0)])
     alive_f = old.alive.astype(dt)
     live_deg, _ = routed.matvec(alive_f, alive_f, interpret=interpret)
+    if clock:
+        live_deg = jnp.where(active, live_deg, 0)
     delivered = _clip_count(
         jnp.sum(jnp.where(old.alive, live_deg, 0))
     )
@@ -449,7 +516,7 @@ def routed_message_counts(
     jax.jit,
     static_argnames=(
         "n", "eps", "streak_target", "predicate", "tol", "all_alive",
-        "targets_alive", "interpret",
+        "targets_alive", "interpret", "clock",
     ),
     inline=True,
 )
@@ -466,6 +533,7 @@ def pushsum_diffusion_round_routed(
     all_alive: bool = False,
     targets_alive: bool = False,
     interpret: bool = False,
+    clock: tuple = (),
 ) -> PushSumState:
     """Fanout-all round with the routed (scatter-free) delivery.
 
@@ -486,9 +554,12 @@ def pushsum_diffusion_round_routed(
     same values the scatter path's delivered-count accounting produces,
     at ~1.5× the per-round cost while a fault plan is in force.
     """
-    from gossipprotocol_tpu.ops.delivery import matvec_payload
+    from gossipprotocol_tpu.ops.delivery import (
+        mask_sender_rows, matvec_payload,
+    )
 
-    del base_key  # deterministic: fanout-all draws nothing
+    if not clock:
+        del base_key  # deterministic: fanout-all draws nothing
     dt = state.w.dtype
     rows = state.w.shape[0]
     deg = routed.degree.astype(dt)
@@ -500,6 +571,15 @@ def pushsum_diffusion_round_routed(
     if not all_alive:
         share_s = jnp.where(rowmask(state.alive, share_s), share_s, 0)
         share_w = jnp.where(state.alive, share_w, 0)
+    if clock:
+        # routed plans are static linear operators: idle senders are
+        # expressed purely by zeroing their input rows, the plan itself
+        # never changes (ops/delivery.py mask_sender_rows)
+        share_s, share_w = mask_sender_rows(
+            share_s, share_w,
+            jax.random.fold_in(base_key, state.round), clock,
+            jnp.arange(rows, dtype=jnp.int32),
+        )
     in_s, in_w = matvec_payload(
         lambda a, b: routed.matvec(a, b, interpret=interpret),
         share_s, share_w,
@@ -528,7 +608,7 @@ def pushsum_diffusion_round_routed(
     jax.jit,
     static_argnames=(
         "n", "eps", "streak_target", "predicate", "tol", "all_alive",
-        "targets_alive", "edge_chunks", "loss_windows",
+        "targets_alive", "edge_chunks", "loss_windows", "clock",
     ),
     inline=True,
 )
@@ -546,6 +626,7 @@ def pushsum_diffusion_round(
     targets_alive: bool = False,
     edge_chunks: int = 1,
     loss_windows: tuple = (),
+    clock: tuple = (),
 ) -> PushSumState:
     """Single-chip fanout-all round (same call shape as ``pushsum_round``)."""
 
@@ -570,6 +651,7 @@ def pushsum_diffusion_round(
         targets_alive=targets_alive,
         edge_chunks=edge_chunks,
         loss_windows=loss_windows,
+        clock=clock,
     )
 
 

@@ -61,6 +61,7 @@ def gossip_round_core(
     inverted: bool = False,
     all_sum=jnp.sum,
     loss_windows: tuple = (),
+    clock: tuple = (),
 ) -> GossipState:
     """One synchronous round over the rows in ``gids``.
 
@@ -91,6 +92,19 @@ def gossip_round_core(
     spreaders = heard if keep_alive else heard & ~state.converged
     if not all_alive:
         spreaders = spreaders & state.alive
+    if clock:
+        # Poisson activation (async_/clock.py): only rows whose clock
+        # ticked spread this round. Config validation pins inverted=False
+        # under a poisson clock — the gather inversion assumes every
+        # eligible node spreads, which activation breaks every round.
+        assert not inverted, "inverted delivery requires the sync clock"
+        from gossipprotocol_tpu.async_.clock import activation_mask
+
+        gid_rows_c = (
+            gids if gids is not None
+            else jnp.arange(state.counts.shape[0], dtype=jnp.int32)
+        )
+        spreaders = spreaders & activation_mask(key, clock, gid_rows_c)
 
     if loss_windows:
         # a lost rumor message simply never lands (gossip needs no mass
@@ -158,7 +172,7 @@ def gossip_round_core(
     jax.jit,
     static_argnames=(
         "n", "threshold", "keep_alive", "all_alive", "inverted",
-        "loss_windows",
+        "loss_windows", "clock",
     ),
     inline=True,
 )
@@ -173,6 +187,7 @@ def gossip_round(
     all_alive: bool = False,
     inverted: bool = False,
     loss_windows: tuple = (),
+    clock: tuple = (),
 ) -> GossipState:
     """Single-chip round. ``nbrs``/``base_key`` are runtime arguments so one
     compiled executable serves every same-shape topology and seed."""
@@ -188,6 +203,7 @@ def gossip_round(
         all_alive=all_alive,
         inverted=inverted,
         loss_windows=loss_windows,
+        clock=clock,
     )
 
 
@@ -220,6 +236,7 @@ def gossip_message_counts(
     keep_alive: bool,
     all_alive: bool,
     loss_windows: tuple = (),
+    clock: tuple = (),
 ) -> jax.Array:
     """Telemetry recount of one gossip round: int32 [sent, delivered,
     dropped] over the local rows (obs/counters.py semantics).
@@ -239,6 +256,15 @@ def gossip_message_counts(
     spreaders = heard if keep_alive else heard & ~old.converged
     if not all_alive:
         spreaders = spreaders & old.alive
+    if clock:
+        from gossipprotocol_tpu.async_.clock import activation_mask
+
+        key_c = jax.random.fold_in(base_key, old.round)
+        gid_rows_c = (
+            gids if gids is not None
+            else jnp.arange(old.counts.shape[0], dtype=jnp.int32)
+        )
+        spreaders = spreaders & activation_mask(key_c, clock, gid_rows_c)
     valid = send_valid_mask(nbrs, n, gids)
     sent_mask = spreaders if valid is None else spreaders & valid
     sent = jnp.sum(sent_mask.astype(jnp.int32))

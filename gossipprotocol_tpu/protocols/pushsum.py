@@ -83,6 +83,7 @@ def pushsum_round_core(
     targets_alive: bool = False,
     delivery: str = "scatter",
     loss_windows: tuple = (),
+    clock: tuple = (),
 ) -> PushSumState:
     """One synchronous round over the rows in ``gids``.
 
@@ -119,10 +120,18 @@ def pushsum_round_core(
       Σ(s·alive)/Σ(w·alive) is computable every round (one reduction; a
       ``psum`` under shard_map via ``all_sum``), and a node converges
       when |s/w − mean| <= tol for ``streak_target`` rounds.
+
+    ``clock`` is the static activation-clock spec
+    (:mod:`gossipprotocol_tpu.async_`): empty for the synchronous clock
+    (this function's body traces byte-identically to the pre-async
+    engine), ``(rate, id_div)`` for Poisson clocks, where only rows whose
+    clock ticked this round send — an inactive sender keeps its whole
+    ``(s, w)``, so mass conservation and both predicates are untouched.
     """
     key = jax.random.fold_in(base_key, state.round)
 
     if delivery == "invert":
+        assert not clock, "delivery='invert' requires the synchronous clock"
         # receiver-side gather delivery (see received_by_inversion): no
         # targets are materialized at all. Build-time validation pinned
         # the legality window: dense table, component-closed dead set,
@@ -151,6 +160,17 @@ def pushsum_round_core(
             deliver = valid & state.alive
         else:
             deliver = valid & state.alive & alive_global[targets]
+        if clock:
+            # Poisson activation: a row whose clock did not tick keeps
+            # its whole pair this round — mechanically identical to a
+            # dead target, so mass stays conserved
+            from gossipprotocol_tpu.async_.clock import activation_mask
+
+            gid_rows = (
+                gids if gids is not None
+                else jnp.arange(state.s.shape[0], dtype=jnp.int32)
+            )
+            deliver = deliver & activation_mask(key, clock, gid_rows)
         if loss_windows:
             # a dropped send keeps its (s, w) half at the sender — same
             # mechanics as a dead target, so Σs/Σw is conserved and the
@@ -313,6 +333,7 @@ def finish_pushsum_round(
     static_argnames=(
         "n", "eps", "streak_target", "reference_semantics", "predicate",
         "tol", "all_alive", "targets_alive", "delivery", "loss_windows",
+        "clock",
     ),
     inline=True,
 )
@@ -331,6 +352,7 @@ def pushsum_round(
     targets_alive: bool = False,
     delivery: str = "scatter",
     loss_windows: tuple = (),
+    clock: tuple = (),
 ) -> PushSumState:
     """Single-chip round. ``nbrs``/``base_key`` are runtime arguments so one
     compiled executable serves every same-shape topology and seed."""
@@ -358,6 +380,7 @@ def pushsum_round(
         targets_alive=targets_alive,
         delivery=delivery,
         loss_windows=loss_windows,
+        clock=clock,
     )
 
 
@@ -398,6 +421,7 @@ def pushsum_message_counts(
     delivery: str,
     loss_windows: tuple,
     alive_global: jax.Array,
+    clock: tuple = (),
 ) -> jax.Array:
     """Telemetry recount of one single-target push-sum round: int32
     [sent, delivered, dropped] over the local rows (obs/counters.py).
@@ -424,6 +448,15 @@ def pushsum_message_counts(
 
     targets, valid = sample_neighbors(nbrs, n, key, gids)
     senders = valid if all_alive else (valid & old.alive)
+    if clock:
+        # inactive rows sent nothing at all this round
+        from gossipprotocol_tpu.async_.clock import activation_mask
+
+        gid_rows_c = (
+            gids if gids is not None
+            else jnp.arange(old.s.shape[0], dtype=jnp.int32)
+        )
+        senders = senders & activation_mask(key, clock, gid_rows_c)
     sent = jnp.sum(senders.astype(jnp.int32))
     if all_alive or targets_alive:
         deliver = senders

@@ -64,6 +64,10 @@ TRAJECTORY_FIELDS = (
     # resume under any other value continues a different trajectory
     "payload_dim", "workload", "accel", "accel_lambda", "lr",
     "local_steps", "sgp_samples", "loss_tol",
+    # the execution clock: a poisson run activates a different sender
+    # subset every round than a sync run, and the rate/grouping select
+    # which subset — resuming under any other clock splices trajectories
+    "clock", "activation_rate", "groups",
 )
 
 
@@ -83,7 +87,13 @@ LEGACY_FIELD_DEFAULTS = {"fanout": "one", "delivery": "scatter",
                          # acceleration (the SGP/accel hyperparameters are
                          # moot under those and wildcard like eps/tol)
                          "payload_dim": 1, "workload": "avg",
-                         "accel": "off"}
+                         "accel": "off",
+                         # pre-async checkpoints ran the only clock that
+                         # existed: synchronous, ungrouped (the rate is
+                         # moot under sync but its default is pinned so
+                         # resumes never wildcard a poisson rate onto it)
+                         "clock": "sync", "activation_rate": 1.0,
+                         "groups": 1}
 
 # Sentinel written for alert_quorum=None (the all-nodes stop rule). None
 # cannot be stored raw: resume validation could not tell "all-nodes run"

@@ -1,0 +1,517 @@
+"""Asynchronous execution model (gossipprotocol_tpu/async_): the poisson
+activation clock and the GALA gossip actor-learner workload.
+
+Determinism contract under test:
+
+* ``clock='sync'`` is the literal pre-async program — pinned by the
+  program-text goldens in tests/test_observatory.py, re-checked here at
+  the trajectory level;
+* ``clock='poisson'`` is seed-deterministic, sharding-invariant (masks
+  key on global node ids through the counter-based run PRNG, exactly
+  like the fault engine's loss windows), and its per-node event counts
+  follow the thinned Poisson process Binomial(R, 1 − e^{−r});
+* engine event counts reproduce the native async oracle's qualitative
+  topology ordering (full < line, tests/test_asyncsim.py style).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.async_ import (
+    CLOCK_FOLD,
+    activation_mask,
+    activation_probability,
+    clock_spec,
+)
+from gossipprotocol_tpu.cli import main as cli_main
+from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+
+
+def leaves_bytes(state):
+    return [np.asarray(leaf).tobytes() for leaf in jax.tree.leaves(state)]
+
+
+# ---------------------------------------------------------------------------
+# clock primitives
+
+
+def test_clock_spec_shapes():
+    assert clock_spec("sync", 1.0) == ()
+    assert clock_spec("sync", 7.5, id_div=16) == ()
+    assert clock_spec("poisson", 2.0) == (2.0, 1)
+    assert clock_spec("poisson", 0.5, id_div=16) == (0.5, 16)
+    with pytest.raises(ValueError):
+        clock_spec("lamport", 1.0)
+
+
+def test_activation_probability_is_thinned_poisson():
+    # P[at least one event in a unit interval of a rate-r process]
+    assert activation_probability(()) == 1.0
+    for r in (0.1, 1.0, 3.0):
+        assert activation_probability(clock_spec("poisson", r)) == (
+            pytest.approx(1.0 - math.exp(-r))
+        )
+
+
+def test_activation_mask_is_counter_based_and_id_keyed():
+    """Same key + same global ids => same draws, regardless of how the
+    id vector is sliced (the sharding-invariance primitive), and the
+    draws differ across rounds/folds."""
+    key = jax.random.fold_in(jax.random.key(3), 17)
+    spec = clock_spec("poisson", 1.0)
+    ids = jnp.arange(256, dtype=jnp.int32)
+    full = np.asarray(activation_mask(key, spec, ids))
+    for lo, hi in ((0, 64), (64, 128), (192, 256)):
+        part = np.asarray(activation_mask(key, spec, ids[lo:hi]))
+        assert np.array_equal(part, full[lo:hi])
+    other = np.asarray(
+        activation_mask(jax.random.fold_in(jax.random.key(3), 18), spec, ids)
+    )
+    assert not np.array_equal(other, full)
+    # group clock: all members of an id_div block share one draw
+    gspec = clock_spec("poisson", 1.0, id_div=64)
+    grouped = np.asarray(activation_mask(key, gspec, ids))
+    for g in range(4):
+        blk = grouped[g * 64:(g + 1) * 64]
+        assert blk.all() or not blk.any()
+
+
+def test_event_counts_follow_binomial():
+    """Over R rounds each node's activation count is Binomial(R, p):
+    check the empirical mean and that per-node counts stay within a wide
+    (~6 sigma) band — a seeded smoke, not a statistical test."""
+    rate, rounds, n = 0.7, 400, 512
+    p = 1.0 - math.exp(-rate)
+    spec = clock_spec("poisson", rate)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    base = jax.random.key(0)
+    counts = np.zeros(n, np.int64)
+    for rnd in range(rounds):
+        key = jax.random.fold_in(base, rnd)
+        counts += np.asarray(activation_mask(key, spec, ids))
+    mean = counts.mean() / rounds
+    assert abs(mean - p) < 0.01
+    sigma = math.sqrt(rounds * p * (1 - p))
+    assert np.all(np.abs(counts - rounds * p) < 6 * sigma)
+
+
+# ---------------------------------------------------------------------------
+# engine integration: determinism + sync-unchanged
+
+
+def test_sync_clock_is_default_and_unchanged():
+    """clock='sync' must produce the identical trajectory as a config
+    that never heard of clocks (same dataclass defaults)."""
+    topo = build_topology("imp3D", 27, seed=2)
+    r0 = run_simulation(topo, RunConfig(algorithm="gossip", seed=5))
+    r1 = run_simulation(
+        topo, RunConfig(algorithm="gossip", seed=5, clock="sync",
+                        activation_rate=9.9))
+    assert r0.rounds == r1.rounds
+    for a, b in zip(leaves_bytes(r0.final_state),
+                    leaves_bytes(r1.final_state)):
+        assert a == b
+
+
+@pytest.mark.parametrize("cfg_kw", [
+    dict(algorithm="gossip"),
+    dict(algorithm="push-sum"),
+    dict(algorithm="push-sum", fanout="all", predicate="global", tol=1e-5),
+])
+def test_poisson_seed_deterministic(cfg_kw):
+    topo = build_topology("erdos_renyi", 64, avg_degree=8.0, seed=3)
+    cfg = RunConfig(seed=11, clock="poisson", activation_rate=1.0,
+                    max_rounds=4000, **cfg_kw)
+    r1 = run_simulation(topo, cfg)
+    r2 = run_simulation(topo, cfg)
+    assert r1.rounds == r2.rounds
+    for a, b in zip(leaves_bytes(r1.final_state),
+                    leaves_bytes(r2.final_state)):
+        assert a == b
+    # and the seed actually matters
+    r3 = run_simulation(
+        topo, RunConfig(seed=12, clock="poisson", activation_rate=1.0,
+                        max_rounds=4000, **cfg_kw))
+    assert leaves_bytes(r3.final_state) != leaves_bytes(r1.final_state)
+
+
+def test_poisson_slows_diffusion_toward_1_over_p():
+    """Fewer activations per round => more rounds to the same tolerance;
+    rate 0.25 (p ≈ 0.22) must be clearly slower than sync on the same
+    graph, in the direction and rough magnitude of the 1/p slowdown."""
+    topo = build_topology("erdos_renyi", 64, avg_degree=8.0, seed=3)
+    kw = dict(algorithm="push-sum", fanout="all", predicate="global",
+              tol=1e-6, seed=2, max_rounds=20000)
+    sync = run_simulation(topo, RunConfig(**kw))
+    slow = run_simulation(
+        topo, RunConfig(clock="poisson", activation_rate=0.25, **kw))
+    assert sync.converged and slow.converged
+    assert slow.rounds > 2 * sync.rounds
+
+
+# ---------------------------------------------------------------------------
+# sharding invariance
+
+
+def test_poisson_gossip_sharded_bitwise_matches_single(cpu_devices):
+    """Integer-state gossip is the repo's bitwise sharding-invariance
+    probe: the poisson masks key on global ids, so 2/4/8 devices replay
+    the single-chip trajectory exactly."""
+    topo = build_topology("full", 64)
+    cfg = RunConfig(algorithm="gossip", seed=5, clock="poisson",
+                    activation_rate=1.0, chunk_rounds=32, max_rounds=4000)
+    r1 = run_simulation(topo, cfg)
+    for d in (2, 4, 8):
+        rs = run_simulation_sharded(
+            topo, cfg, mesh=make_mesh(devices=cpu_devices[:d]))
+        assert rs.rounds == r1.rounds, f"devices={d}"
+        assert np.array_equal(np.asarray(r1.final_state.counts),
+                              np.asarray(rs.final_state.counts)), (
+            f"devices={d}")
+
+
+@pytest.mark.parametrize("cfg_kw", [
+    dict(algorithm="push-sum"),
+    dict(algorithm="push-sum", fanout="all"),
+])
+def test_poisson_pushsum_sharded_matches_single(cfg_kw, cpu_devices):
+    """Float push-sum keeps the repo's existing sharded contract (scatter
+    sums reorder across shards => float32 tolerance, same as the sync
+    test in test_sharded.py), with the global predicate pinning the
+    round count."""
+    topo = build_topology("erdos_renyi", 64, avg_degree=8.0, seed=3)
+    cfg = RunConfig(seed=7, clock="poisson", activation_rate=1.0,
+                    predicate="global", tol=1e-6, chunk_rounds=64,
+                    max_rounds=8000, **cfg_kw)
+    r1 = run_simulation(topo, cfg)
+    assert r1.converged
+    for d in (2, 4, 8):
+        rs = run_simulation_sharded(
+            topo, cfg, mesh=make_mesh(devices=cpu_devices[:d]))
+        assert rs.converged
+        assert rs.rounds == r1.rounds, f"devices={d}"
+        np.testing.assert_allclose(
+            np.asarray(r1.final_state.ratio),
+            np.asarray(rs.final_state.ratio), atol=1e-5)
+        np.testing.assert_allclose(
+            float(np.asarray(rs.final_state.w).sum()), topo.num_nodes,
+            rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# counters under the poisson clock
+
+
+def test_poisson_counters_match_activation_oracle(tmp_path):
+    """All-alive lossless fanout-one push-sum under poisson: sent ==
+    delivered == the total number of clock ticks, re-derived exactly
+    from the same counter-based fold the engine used."""
+    from gossipprotocol_tpu.obs import Telemetry
+
+    n, rate = 32, 0.8
+    topo = build_topology("line", n, seed=0)
+    tel = Telemetry(str(tmp_path / "tel"))
+    cfg = RunConfig(algorithm="push-sum", seed=1, clock="poisson",
+                    activation_rate=rate, max_rounds=4000, telemetry=tel)
+    res = run_simulation(topo, cfg)
+    tel.close()
+    spec = clock_spec("poisson", rate)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    base = jax.random.key(cfg.seed)
+    ticks = sum(
+        int(np.asarray(activation_mask(
+            jax.random.fold_in(base, rnd), spec, ids)).sum())
+        for rnd in range(res.rounds)
+    )
+    assert tel.totals["sent"] == ticks
+    assert tel.totals["delivered"] == ticks
+    assert tel.totals["dropped"] == 0
+
+
+def test_poisson_diffusion_counters_walk_active_edges(tmp_path):
+    """Fanout-all under poisson: each round walks exactly the directed
+    edges of *active* sources — sent == delivered == sum of active
+    degrees."""
+    from gossipprotocol_tpu.obs import Telemetry
+
+    n, rate = 16, 0.6
+    topo = build_topology("line", n, seed=0)
+    tel = Telemetry(str(tmp_path / "tel"))
+    cfg = RunConfig(algorithm="push-sum", fanout="all", seed=1,
+                    clock="poisson", activation_rate=rate,
+                    max_rounds=4000, telemetry=tel)
+    res = run_simulation(topo, cfg)
+    tel.close()
+    spec = clock_spec("poisson", rate)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    base = jax.random.key(cfg.seed)
+    deg = np.asarray(topo.degree)[:n]
+    edges = sum(
+        int(deg[np.asarray(activation_mask(
+            jax.random.fold_in(base, rnd), spec, ids))].sum())
+        for rnd in range(res.rounds)
+    )
+    assert tel.totals["sent"] == edges
+    assert tel.totals["delivered"] == edges
+    assert tel.totals["dropped"] == 0
+
+
+def test_poisson_telemetry_bitwise_invariance(tmp_path):
+    """Zero-cost-off holds on the poisson branch too: counters on/off
+    must not perturb the async trajectory."""
+    from gossipprotocol_tpu.obs import Telemetry
+
+    topo = build_topology("line", 32, seed=0)
+    kw = dict(algorithm="push-sum", seed=3, clock="poisson",
+              activation_rate=1.0, max_rounds=2000)
+    r_off = run_simulation(topo, RunConfig(**kw))
+    tel = Telemetry(str(tmp_path / "tel"))
+    r_on = run_simulation(topo, RunConfig(telemetry=tel, **kw))
+    tel.close()
+    assert r_on.rounds == r_off.rounds
+    for a, b in zip(leaves_bytes(r_off.final_state),
+                    leaves_bytes(r_on.final_state)):
+        assert a == b
+    assert tel.totals["sent"] > 0
+
+
+# ---------------------------------------------------------------------------
+# native-oracle cross-validation
+
+
+def test_poisson_event_counts_match_native_ordering(tmp_path, native_oracle):
+    """The engine's asynchronous event counts (total clock ticks to
+    convergence under the poisson clock) reproduce the native async
+    oracle's qualitative topology ordering at n=343: full < line
+    (tests/test_asyncsim.py, Report.pdf p.1)."""
+    from gossipprotocol_tpu.obs import Telemetry
+
+    n = 343
+    native_full = native_oracle.async_gossip_events(
+        build_topology("full", n), seed=9)
+    native_line = native_oracle.async_gossip_events(
+        build_topology("line", n), seed=9)
+    assert native_full < native_line
+
+    def engine_events(kind, sub):
+        tel = Telemetry(str(tmp_path / sub))
+        cfg = RunConfig(algorithm="gossip", seed=9, clock="poisson",
+                        activation_rate=1.0, max_rounds=60000,
+                        telemetry=tel)
+        res = run_simulation(build_topology(kind, n), cfg)
+        tel.close()
+        assert res.converged, kind
+        return tel.totals["sent"]
+
+    assert engine_events("full", "f") < engine_events("line", "l")
+
+
+# ---------------------------------------------------------------------------
+# GALA
+
+
+def test_gala_converges_and_trains():
+    """GALA smoke: 4 groups on K_64 reach inter-group consensus and a
+    loss plateau; the final mean train loss must have actually dropped
+    from the x=0 start."""
+    from gossipprotocol_tpu.learn import make_least_squares
+
+    n, d = 64, 4
+    topo = build_topology("full", n)
+    cfg = RunConfig(algorithm="push-sum", workload="gala", groups=4,
+                    fanout="all", predicate="global", tol=1e-4,
+                    payload_dim=d, seed=0, max_rounds=5000)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    final_loss = float(res.final_state.loss)
+    a, b, _ = make_least_squares(n, d, cfg.sgp_samples, cfg.seed)
+    loss_at_zero = float((b ** 2).mean())
+    assert 0 < final_loss < 0.5 * loss_at_zero
+    # group members ended exactly synchronized (the intra-group average)
+    ratio = np.asarray(res.final_state.ratio)
+    for g in range(4):
+        blk = ratio[g * 16:(g + 1) * 16]
+        assert np.allclose(blk, blk[0], atol=1e-5)
+
+
+def test_gala_poisson_group_clock():
+    """GALA + poisson: groups tick as units (id_div = group size), the
+    run is seed-deterministic and still converges."""
+    topo = build_topology("full", 64)
+    cfg = RunConfig(algorithm="push-sum", workload="gala", groups=4,
+                    fanout="all", predicate="global", tol=1e-4,
+                    payload_dim=4, seed=0, clock="poisson",
+                    activation_rate=1.0, max_rounds=8000)
+    r1 = run_simulation(topo, cfg)
+    r2 = run_simulation(topo, cfg)
+    assert r1.converged
+    assert r1.rounds == r2.rounds
+    for a, b in zip(leaves_bytes(r1.final_state),
+                    leaves_bytes(r2.final_state)):
+        assert a == b
+
+
+def test_gala_sharded_matches_single(cpu_devices):
+    topo = build_topology("full", 64)
+    cfg = RunConfig(algorithm="push-sum", workload="gala", groups=4,
+                    fanout="all", predicate="global", tol=1e-4,
+                    payload_dim=4, seed=0, clock="poisson",
+                    activation_rate=1.0, chunk_rounds=64, max_rounds=8000)
+    r1 = run_simulation(topo, cfg)
+    assert r1.converged
+    for d in (2, 4, 8):
+        rs = run_simulation_sharded(
+            topo, cfg, mesh=make_mesh(devices=cpu_devices[:d]))
+        assert rs.converged
+        assert rs.rounds == r1.rounds, f"devices={d}"
+        np.testing.assert_allclose(
+            np.asarray(r1.final_state.ratio),
+            np.asarray(rs.final_state.ratio), atol=1e-5)
+        assert float(rs.final_state.loss) == pytest.approx(
+            float(r1.final_state.loss), abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# config validation (exit-2 contract)
+
+
+def run_cli(args, capsys):
+    code = cli_main(args)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+@pytest.mark.parametrize("argv, needle", [
+    (["64", "full", "push-sum", "--clock", "lamport"], "--clock"),
+    (["64", "full", "push-sum", "--clock", "poisson",
+      "--activation-rate", "0"], "--activation-rate"),
+    (["64", "full", "push-sum", "--clock", "poisson",
+      "--activation-rate", "-1"], "--activation-rate"),
+    (["64", "full", "push-sum", "--groups", "0"], "--groups"),
+])
+def test_bad_clock_flags_are_usage_errors(argv, needle, capsys):
+    with pytest.raises(SystemExit) as exc:
+        cli_main(argv)
+    assert exc.value.code == 2
+    assert needle in capsys.readouterr().err
+
+
+@pytest.mark.parametrize("argv, needle", [
+    # poisson × accelerated schemes: fixed-W assumption broken
+    (["64", "full", "push-sum", "--fanout", "all", "--accel", "epd",
+      "--clock", "poisson"], "accel"),
+    # poisson × reference semantics: the baseline is synchronous
+    (["27", "full", "push-sum", "--semantics", "reference",
+      "--clock", "poisson"], "reference"),
+    # poisson × invert: reconstruction assumes every sender sent
+    (["64", "imp3D", "push-sum", "--delivery", "invert",
+      "--clock", "poisson"], "invert"),
+    # gala × accel
+    (["64", "full", "push-sum", "--workload", "gala", "--groups", "4",
+      "--fanout", "all", "--predicate", "global", "--accel", "epd"],
+     "accel"),
+    # gala needs >= 2 groups
+    (["64", "full", "push-sum", "--workload", "gala", "--fanout", "all",
+      "--predicate", "global"], "groups"),
+    # groups without gala
+    (["64", "full", "push-sum", "--groups", "4"], "gala"),
+    # gala × gossip
+    (["64", "full", "gossip", "--workload", "gala", "--groups", "4"],
+     "push-sum"),
+    # indivisible group count
+    (["60", "full", "push-sum", "--workload", "gala", "--groups", "7",
+      "--fanout", "all", "--predicate", "global"], "divisible"),
+])
+def test_unsupported_clock_combos_exit_2(argv, needle, capsys):
+    code, _, err = run_cli(argv, capsys)
+    assert code == 2
+    assert needle in err
+
+
+def test_runconfig_rejects_bad_clock_values():
+    with pytest.raises(ValueError):
+        RunConfig(clock="vector")
+    with pytest.raises(ValueError):
+        RunConfig(clock="poisson", activation_rate=0.0)
+    with pytest.raises(ValueError):
+        RunConfig(clock="poisson", accel="epd", fanout="all")
+    with pytest.raises(ValueError):
+        RunConfig(clock="poisson", semantics="reference")
+
+
+def test_clock_fold_is_distinct_domain():
+    from gossipprotocol_tpu.protocols.sampling import LOSS_FOLD
+
+    assert CLOCK_FOLD != LOSS_FOLD
+
+
+# ---------------------------------------------------------------------------
+# predictor + manifest
+
+
+def test_predictor_scales_by_inverse_activation():
+    from gossipprotocol_tpu.obs.predict import predict_rounds
+
+    topo = build_topology("erdos_renyi", 64, avg_degree=8.0, seed=3)
+    kw = dict(algorithm="push-sum", fanout="all", predicate="global",
+              tol=1e-6)
+    sync_doc = predict_rounds(topo, RunConfig(**kw))
+    rate = 0.5
+    poisson_doc = predict_rounds(
+        topo, RunConfig(clock="poisson", activation_rate=rate, **kw))
+    assert sync_doc["clock"] == "sync"
+    assert poisson_doc["clock"] == "poisson"
+    p = 1.0 - math.exp(-rate)
+    assert poisson_doc["activation_probability"] == pytest.approx(p)
+    assert poisson_doc["predicted_rounds"] == pytest.approx(
+        sync_doc["predicted_rounds"] / p, rel=0.02)
+
+
+def test_manifest_records_clock(tmp_path):
+    import json
+
+    from gossipprotocol_tpu.obs import Telemetry, write_manifest
+
+    topo = build_topology("erdos_renyi", 64, avg_degree=8.0, seed=3)
+    tel = Telemetry(str(tmp_path / "tel"))
+    cfg = RunConfig(algorithm="push-sum", fanout="all", predicate="global",
+                    tol=1e-6, seed=1, clock="poisson", activation_rate=0.5,
+                    max_rounds=20000, telemetry=tel, round_budget="auto")
+    res = run_simulation(topo, cfg)
+    tel.close()
+    assert res.converged
+    path = write_manifest(tel, cfg, topo, res)
+    with open(path) as fh:
+        manifest = json.load(fh)
+    assert manifest["config"]["clock"] == "poisson"
+    assert manifest["config"]["activation_rate"] == 0.5
+    pred = manifest.get("prediction")
+    assert pred and pred["clock"] == "poisson"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint trajectory fields
+
+
+def test_checkpoint_clock_fields_guard_resume():
+    from gossipprotocol_tpu.utils.checkpoint import (
+        LEGACY_FIELD_DEFAULTS,
+        TRAJECTORY_FIELDS,
+        field_matches,
+    )
+
+    for f in ("clock", "activation_rate", "groups"):
+        assert f in TRAJECTORY_FIELDS
+    assert LEGACY_FIELD_DEFAULTS["clock"] == "sync"
+    # a pre-async checkpoint (no clock key) resumes under sync...
+    assert field_matches({}, "clock", "sync")
+    # ...but NOT under poisson (that would splice trajectories)
+    assert not field_matches({}, "clock", "poisson")
+    assert field_matches({"clock": "poisson"}, "clock", "poisson")
+    assert not field_matches({"clock": "poisson"}, "clock", "sync")

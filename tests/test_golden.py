@@ -7,7 +7,9 @@ Push-sum is float32; its trajectory is deterministic on a given backend
 but reduction order differs across backends, so it is pinned **exactly
 per backend** (CPU — what the suite runs on — and TPU v5e, recorded on
 the real chip), with a wide −50 %/+25 % band around the CPU reference
-as the fallback for any other backend (both recorded tables fit it).
+as the fallback for any other backend (a coarse smoke only: the
+eps-streak chaos documented below can move an unrecorded backend far
+outside it — record an exact table instead).
 
 The suite's conftest pins every computation to CPU, so the TPU table is
 exercised by explicit opt-in on a TPU host:
@@ -48,13 +50,18 @@ GOLDEN_GOSSIP = {
 
 # backend -> {(topology, n) -> pushsum_rounds} (exact per backend)
 GOLDEN_PUSHSUM = {
+    # re-recorded 2026-08 after an XLA:CPU toolchain upgrade moved the
+    # float reduction order (gossip's integer table was bitwise
+    # unchanged, confirming identical threefry draws — this is exactly
+    # the on-chip drift the per-backend exact pin exists to catch).
+    # power_law's 649 -> 108 swing is the documented eps-streak chaos.
     "cpu": {
         ("line", 64): 193,
-        ("full", 128): 87,
+        ("full", 128): 67,
         ("3D", 64): 149,
-        ("imp3D", 64): 124,
-        ("erdos_renyi", 128): 111,
-        ("power_law", 128): 649,
+        ("imp3D", 64): 121,
+        ("erdos_renyi", 128): 128,
+        ("power_law", 128): 108,
     },
     # recorded on a real TPU v5e (axon); gossip rounds verified identical
     "tpu": {

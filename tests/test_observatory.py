@@ -66,6 +66,28 @@ _PROGRAM_CASES = {
         algorithm="push-sum", fanout="all", predicate="global",
         delivery="pallas",
     ),
+    # async-clock pins (ISSUE 12): clock='sync' cases above must stay
+    # byte-identical to the pre-async capture (the empty clock spec is a
+    # trace-time no-op); these pin the poisson-gated programs themselves
+    "gossip_poisson": dict(
+        algorithm="gossip", clock="poisson", activation_rate=1.0,
+    ),
+    "pushsum_one_poisson": dict(
+        algorithm="push-sum", clock="poisson", activation_rate=0.5,
+    ),
+    "diffusion_poisson": dict(
+        algorithm="push-sum", fanout="all", predicate="global",
+        clock="poisson", activation_rate=1.0,
+    ),
+    "gala": dict(
+        algorithm="push-sum", workload="gala", groups=4, fanout="all",
+        predicate="global", payload_dim=2,
+    ),
+    "gala_poisson": dict(
+        algorithm="push-sum", workload="gala", groups=4, fanout="all",
+        predicate="global", payload_dim=2, clock="poisson",
+        activation_rate=1.0,
+    ),
 }
 
 

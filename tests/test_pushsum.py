@@ -5,6 +5,7 @@ its convergence predicate was broken (Program.fs:109-114)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from gossipprotocol_tpu import build_topology
 from gossipprotocol_tpu.protocols import (
@@ -280,6 +281,39 @@ def test_inverted_delivery_sharded_rejected(cpu_devices):
         run_simulation_sharded(topo, cfg, mesh=make_mesh(devices=cpu_devices[:8]))
 
 
+def _f32_dry_spell_saturates() -> bool:
+    """Whether this XLA:CPU build's flush-to-zero hits ``w * 0.5`` (the
+    sent half) *before* the subtract: then a dry-spell node computes
+    ``w - 0`` once its half-share goes subnormal and w freezes at
+    ~2^-126 forever — the exact-zero underflow the two tests below pin
+    structurally cannot form. Other builds flush the *result* of the
+    halving chain instead, where w does reach exact 0 (the count is
+    lowering-dependent, see the sharded-mirror comment below). The probe
+    must go through a scatter-add like the delivery path does — the
+    plain ``v - v*0.5`` form is algebraically rewritten to ``v*0.5`` and
+    flushes to 0 even on builds where the scatter lowering saturates."""
+    def step(v, m):
+        sent = jnp.where(m, v * jnp.float32(0.5), jnp.zeros_like(v))
+        inbox = jnp.zeros_like(v).at[jnp.arange(v.shape[0])].add(sent * 0)
+        return v - sent + inbox
+
+    stepf = jax.jit(step)
+    v = jnp.full((4,), 2.0 ** -120, jnp.float32)
+    m = jnp.ones((4,), bool)
+    for _ in range(40):
+        v = stepf(v, m)
+    return float(v[0]) != 0.0
+
+
+_FTZ_SKIP = pytest.mark.skipif(
+    _f32_dry_spell_saturates(),
+    reason="this XLA CPU build flushes w*0.5 to zero before the subtract, "
+           "so dry-spell w saturates at ~2^-126 and never underflows to "
+           "exact 0",
+)
+
+
+@_FTZ_SKIP
 def test_f32_dry_spell_underflow_scale_wall():
     """The 100M-scale wall, pinned at n=51: a node in a receipt dry spell
     halves (s, w) every round, so a gap of ~150 rounds drives f32 w
@@ -314,6 +348,7 @@ def test_f32_dry_spell_underflow_scale_wall():
     assert (wd > 1e-6).all()
 
 
+@_FTZ_SKIP
 def test_w_underflow_detector_single_and_sharded(capsys, cpu_devices):
     """The engine counts alive nodes whose w underflowed to 0 (the
     dry-spell wall's runtime signature) in every chunk record — single
