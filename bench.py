@@ -21,17 +21,24 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def _probe_backend() -> None:
+def _probe_backend() -> int:
     """Fast-fail when the accelerator worker is dead or unreachable.
 
     ``jax.devices()`` against a dead remote TPU worker hangs the calling
     process indefinitely — the 1M benchmark then burns its whole harness
     budget producing nothing. The probe initializes the backend in a
     THROWAWAY subprocess under a short timeout (``$BENCH_PROBE_TIMEOUT``
-    seconds, default 60; <=0 disables) and, on timeout or nonzero exit,
-    emits one parseable ``{"worker_down": true, "probe_s": ...}`` line
-    and exits nonzero, so a scheduler can distinguish "worker down" from
-    "benchmark regressed" without reading a traceback.
+    seconds, default 60; <=0 disables), retrying transient failures with
+    exponential backoff (up to ``$BENCH_PROBE_ATTEMPTS`` attempts,
+    default and cap 3 — remote workers routinely drop one init during a
+    restart window and come back seconds later). Only after the final
+    attempt does it emit one parseable ``{"worker_down": true,
+    "infra_failure": true, "attempts": N, ...}`` line and exit nonzero,
+    so a scheduler can distinguish "worker down" from "benchmark
+    regressed" without reading a traceback. Returns the number of
+    attempts spent (1 = clean first try), stamped into the BENCH record
+    as ``probe_attempts`` — every hardware run since r4 died on infra
+    with no structured trail.
 
     Limit: this only protects the probe's device init. If the image's
     sitecustomize pre-initializes the backend at interpreter startup
@@ -40,32 +47,42 @@ def _probe_backend() -> None:
     """
     timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
     if timeout_s <= 0:
-        return
+        return 0
+    max_attempts = min(3, max(1, int(os.environ.get(
+        "BENCH_PROBE_ATTEMPTS", "3"))))
     t0 = time.perf_counter()
     code = "import jax; print(jax.default_backend(), len(jax.devices()))"
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout_s)
-        ok = proc.returncode == 0
-        detail = (proc.stderr or proc.stdout).strip()[-200:]
-    except subprocess.TimeoutExpired:
-        ok = False
-        detail = f"device init exceeded {timeout_s:.0f}s"
-    if not ok:
-        # the record must still say WHERE it died even with the worker
-        # gone: host peak RSS + the backend that was requested (the live
-        # backend is unreachable by definition here)
-        from gossipprotocol_tpu.obs.resources import host_peak_rss_bytes
+    detail = ""
+    for attempt in range(1, max_attempts + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s)
+            ok = proc.returncode == 0
+            detail = (proc.stderr or proc.stdout).strip()[-200:]
+        except subprocess.TimeoutExpired:
+            ok = False
+            detail = f"device init exceeded {timeout_s:.0f}s"
+        if ok:
+            return attempt
+        if attempt < max_attempts:
+            time.sleep(2.0 ** (attempt - 1))  # 1s, 2s between attempts
+    # the record must still say WHERE it died even with the worker
+    # gone: host peak RSS + the backend that was requested (the live
+    # backend is unreachable by definition here). infra_failure marks
+    # this as an infrastructure death, not a benchmark regression
+    from gossipprotocol_tpu.obs.resources import host_peak_rss_bytes
 
-        print(json.dumps({
-            "worker_down": True,
-            "probe_s": round(time.perf_counter() - t0, 2),
-            "detail": detail,
-            "peak_rss_bytes": host_peak_rss_bytes(),
-            "requested_backend": os.environ.get("JAX_PLATFORMS", "auto"),
-        }), flush=True)
-        sys.exit(3)
+    print(json.dumps({
+        "worker_down": True,
+        "infra_failure": True,
+        "attempts": max_attempts,
+        "probe_s": round(time.perf_counter() - t0, 2),
+        "detail": detail,
+        "peak_rss_bytes": host_peak_rss_bytes(),
+        "requested_backend": os.environ.get("JAX_PLATFORMS", "auto"),
+    }), flush=True)
+    sys.exit(3)
 
 
 def _bench_telemetry_dir() -> str:
@@ -175,7 +192,7 @@ def _delivery_microbench() -> None:
 
 
 def main():
-    _probe_backend()
+    probe_attempts = _probe_backend()
 
     if os.environ.get("BENCH_DELIVERY_ONLY", "0") == "1":
         _delivery_microbench()
@@ -275,6 +292,11 @@ def main():
         # heuristic — obs/predict.py); None if prediction was skipped
         "prediction_ratio": prediction_ratio,
         "predicted_rounds": pred.get("predicted_rounds"),
+        # infra trail: the run got past the probe (so not an infra
+        # death) and how many probe attempts the backend needed — >1
+        # flags a flaky worker even when the benchmark itself succeeded
+        "infra_failure": False,
+        "probe_attempts": probe_attempts,
         **aux_vec,
     }
     # backup record on stderr BEFORE the 10M attempt: a process-fatal 10M

@@ -21,6 +21,9 @@ inversion), ``--metrics-out``, ``--checkpoint-dir``, ``--resume``,
 ``--fail-fraction/--fail-round``, ``--revive-round`` (churn),
 ``--drop-prob/--drop-window`` (mass-conserving message loss),
 ``--fault-plan`` (declarative JSON fault schedule),
+``--event-plan``/``--churn`` (unified topology-schedule event engine:
+timed edge add/remove/swap events + seeded synthetic churn, bitwise
+replayable across resume),
 ``--repair`` (self-healing topology repair under churn),
 ``--devices`` (multi-chip sharding),
 ``--ws-k/--ws-beta`` (small-world knobs), ``--profile-dir``,
@@ -99,8 +102,8 @@ def _open_unit(s: str) -> float:
     return v
 
 
-def _build_config(args, algo, fault_schedule, jnp, alert_quorum=None,
-                  telemetry=None):
+def _build_config(args, algo, fault_schedule, jnp, event_plan=None,
+                  alert_quorum=None, telemetry=None):
     """argv -> RunConfig; raises ValueError on invalid combinations
     (caught by main and reported as exit 2, the bad-input contract)."""
     from gossipprotocol_tpu.engine import RunConfig
@@ -151,6 +154,7 @@ def _build_config(args, algo, fault_schedule, jnp, alert_quorum=None,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
         fault_schedule=fault_schedule,
+        event_plan=event_plan,
         repair=args.repair,
         round_budget=round_budget,
     )
@@ -505,6 +509,25 @@ def build_parser() -> argparse.ArgumentParser:
                         '"revive": [{"round": R, "ids": [...]}], '
                         '"loss": [{"start": A, "stop": B, "prob": P}]}. '
                         "Merged with the --fail-*/--revive-*/--drop-* sugar")
+    p.add_argument("--event-plan", type=str, default=None, metavar="FILE",
+                   help="declarative topology schedule (JSON, events/): "
+                        '{"add_edges": [{"round": R, "edges": [[u, v], '
+                        '...]}], "remove_edges": [...], "swap_neighbors": '
+                        '[{"round": R, "pairs": [[[u1,v1],[u2,v2]], ...]}], '
+                        '"churn": {"rate": F, "model": "edge"|"swap", '
+                        '"period": P}} — may also carry the kill/revive/'
+                        "loss keys of --fault-plan (one document for the "
+                        "whole schedule). Events fire at chunk boundaries, "
+                        "conserve push-sum mass across every rebuild, and "
+                        "replay bitwise across checkpoint resume")
+    p.add_argument("--churn", type=str, default=None,
+                   metavar="RATE,MODEL[,PERIOD]",
+                   help="seeded synthetic churn sugar: every PERIOD rounds "
+                        "(default 10) touch RATE of the current edges — "
+                        "model 'edge' removes/adds that many edges "
+                        "(membership churn), 'swap' crosses edge pairs "
+                        "degree-preservingly (mobility). Deterministic from "
+                        "--seed; combines with --event-plan")
     p.add_argument("--repair", choices=["off", "prune", "rewire"],
                    default="off",
                    help="self-healing topology repair at fault events. "
@@ -693,10 +716,39 @@ def main(argv=None) -> int:
 
     import dataclasses
 
+    event_plan = None
+    try:
+        if args.event_plan is not None:
+            from gossipprotocol_tpu.events import parse_event_plan
+
+            event_plan, plan_sched = parse_event_plan(
+                args.event_plan, topo.num_nodes, seed=args.seed)
+            # the plan's kill/revive/loss keys merge with the legacy
+            # flags: both spellings compile down to the same engine
+            schedule = faults.merge_schedules(schedule, plan_sched)
+        if args.churn is not None:
+            from gossipprotocol_tpu.events import EventPlan, parse_churn_arg
+
+            spec = parse_churn_arg(args.churn)
+            if event_plan is not None and event_plan.churn is not None:
+                raise ValueError(
+                    "--churn and an event-plan 'churn' generator both "
+                    "given — configure one")
+            event_plan = dataclasses.replace(
+                event_plan if event_plan is not None else EventPlan(),
+                churn=spec)
+        if event_plan is not None and topo.implicit_full:
+            raise ValueError(
+                "event plans need an explicit edge list; the implicit "
+                "complete graph has no CSR to rewrite")
+    except (ValueError, OSError, KeyError) as e:
+        print(f"event plan invalid: {e}", file=sys.stderr)
+        return 2
+
     import jax.numpy as jnp
 
     try:
-        cfg = _build_config(args, algo, schedule, jnp,
+        cfg = _build_config(args, algo, schedule, jnp, event_plan=event_plan,
                             alert_quorum=alert_quorum,
                             telemetry=tel if tel.enabled else None)
         if cfg.delivery == "invert":

@@ -176,6 +176,15 @@ class RunConfig:
                                    # topology/repair.py). Trajectory
                                    # field: the policy rewrites the
                                    # adjacency mid-run
+    event_plan: Optional[Any] = None  # events.EventPlan: timed edge
+                                   # add/remove/swap events + optional
+                                   # synthetic churn generator, executed
+                                   # through the unified host-event
+                                   # pipeline (events/). Trajectory
+                                   # field (stored as its content
+                                   # digest): the plan rewrites the
+                                   # adjacency mid-run exactly like
+                                   # repair does
     telemetry: Optional[Any] = None  # obs.Telemetry hub (None = off). Off
                                    # means *zero cost*: the compiled
                                    # programs are the ones this config
@@ -208,6 +217,15 @@ class RunConfig:
         from gossipprotocol_tpu.utils import faults
 
         return faults.as_schedule(self.fault_schedule, self.fault_plan)
+
+    @property
+    def events(self):
+        """The effective :class:`~gossipprotocol_tpu.events.plan.
+        EventPlan` — always a plan object (possibly empty), so call
+        sites test ``plan.has_events`` instead of None-checking."""
+        from gossipprotocol_tpu.events import plan as events_plan
+
+        return events_plan.as_plan(self.event_plan)
 
     def __post_init__(self):
         if self.algorithm not in ALGORITHMS:
@@ -255,6 +273,15 @@ class RunConfig:
         from gossipprotocol_tpu.topology.repair import validate_policy
 
         validate_policy(self.repair)
+        # structural plan check (id-range validation needs the node count
+        # and runs at engine entry, where the topology is known)
+        plan = self.events.validate()
+        if plan and self.semantics == "reference":
+            raise ValueError(
+                "event plans rewrite the adjacency mid-run; "
+                "semantics='reference' replays the F# baseline's static "
+                "world and rejects topology schedules"
+            )
         if self.repair != "off" and self.semantics == "reference":
             raise ValueError(
                 "repair applies to faulted runs; semantics='reference' "
@@ -321,12 +348,13 @@ class RunConfig:
                     "only (gossip picks its inverted delivery automatically; "
                     "diffusion walks every edge and has nothing to invert)"
                 )
-            if sched:
+            if sched or plan:
                 raise ValueError(
                     "delivery='invert' is exact only while no node can die "
-                    "mid-run and every send lands (receivers recompute "
-                    "senders' draws without checking liveness or loss); "
-                    "drop the fault schedule or use delivery='scatter'"
+                    "mid-run, every send lands, and the adjacency never "
+                    "changes (receivers recompute senders' draws against "
+                    "the compiled graph); drop the fault schedule / event "
+                    "plan or use delivery='scatter'"
                 )
         if self.payload_dim < 1:
             raise ValueError("payload_dim must be >= 1")
@@ -405,12 +433,12 @@ class RunConfig:
                     "workload='gala' supports delivery='scatter' (same "
                     "contract as workload='sgp')"
                 )
-            if sched:
+            if sched or plan:
                 raise ValueError(
                     "workload='gala' keeps groups exactly synchronized "
-                    "by intra-group averaging; fault strikes and loss "
-                    "windows are not modeled for it yet — drop the "
-                    "fault schedule"
+                    "by intra-group averaging; fault strikes, loss "
+                    "windows and topology events are not modeled for it "
+                    "yet — drop the fault schedule / event plan"
                 )
         if self.accel not in ("off", "chebyshev", "epd"):
             raise ValueError("accel must be 'off', 'chebyshev', or 'epd'")
@@ -470,6 +498,11 @@ class RunConfig:
                 raise ValueError(
                     "accel assumes a fixed mixing matrix; repair rewrites "
                     "the adjacency mid-run"
+                )
+            if plan:
+                raise ValueError(
+                    "accel assumes a fixed mixing matrix; an event plan "
+                    "rewrites the adjacency mid-run"
                 )
         if self.accel_lambda is not None and not (
             0.0 < self.accel_lambda < 1.0
@@ -1385,18 +1418,13 @@ def _drive(
     ``cfg.round_budget == "auto"`` and is updated in place with the
     actual outcome so the manifest records predicted-vs-actual.
     """
+    from gossipprotocol_tpu.events import HostEvents
     from gossipprotocol_tpu.obs import as_telemetry
     from gossipprotocol_tpu.obs.counters import ulp_drift
     from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
-    from gossipprotocol_tpu.utils import faults as faults_mod
 
     tel = as_telemetry(cfg.telemetry)
     run_topo = run_topo if run_topo is not None else topo
-    sched = cfg.schedule
-    kills = {r: np.asarray(v, dtype=np.int64)
-             for r, v in sched.kills.items()}
-    revives = {r: np.asarray(v, dtype=np.int64)
-               for r, v in sched.revives.items()}
     chunk_rounds = cfg.resolve_chunk_rounds(
         topo.num_nodes,
         None if topo.implicit_full else int(topo.indices.size),
@@ -1408,12 +1436,12 @@ def _drive(
     # a checkpoint taken at round C reflects every event with r < C
     # (events fire at loop top for r <= cur_round; chunks stop exactly at
     # event rounds; checkpoints are written post-chunk) but never r == C.
-    # On resume, prune exactly the strictly-past events: re-firing a kill
-    # could re-kill a node revived since, and a revive reset is not
-    # idempotent (it would wipe mass the node has mixed in since rejoining)
+    # On resume, HostEvents prunes exactly the strictly-past events:
+    # re-firing a kill could re-kill a node revived since, and a revive
+    # reset is not idempotent (it would wipe mass the node has mixed in
+    # since rejoining)
     cur_round = int(np.asarray(jax.device_get(state.round)))
-    kills = {r: v for r, v in kills.items() if r >= cur_round}
-    revives = {r: v for r, v in revives.items() if r >= cur_round}
+    host_events = HostEvents(topo, cfg, start_round=cur_round, tel=tel)
     done = False
     # round budget: an explicit int, or the analytic prediction's bound
     # ("auto" — run_simulation guarantees `prediction` is present then)
@@ -1444,125 +1472,32 @@ def _drive(
     while True:
         if cur_round >= cfg.max_rounds:
             break
-        # fault events (SURVEY.md §5.3): strike everything due — several
-        # rounds' worth after a resume lands mid-schedule — in round
-        # order, kills before revives within the batch; the round_limit
-        # below guarantees we stop exactly at the next scheduled event so
-        # none can be skipped
-        due_k = sorted(r for r in kills if r <= cur_round)
-        due_r = sorted(r for r in revives if r <= cur_round)
-        if due_k or due_r:
-            with tel.span("fault_event", round=cur_round,
-                          kills=len(due_k), revives=len(due_r)):
-                alive_host = np.array(ckpt_mod.fetch_host(state.alive))  # writable copy
-                before = alive_host.copy()
-                req_revive = (np.concatenate([revives[r] for r in due_r])
-                              if due_r else np.empty(0, np.int64))
-                for r in due_k:
-                    alive_host[kills.pop(r)] = False
-                for r in due_r:
-                    alive_host[revives.pop(r)] = True
-                repair_stats = None
-                if cfg.repair == "off":
-                    # unreachable-from-the-majority == failed: stranded
-                    # survivors and fault-split minority components would hang
-                    # the predicate forever (majority-partition semantics).
-                    # Re-run after revives too: a returning node counts only
-                    # once it is reattached to the majority component —
-                    # otherwise it stays dead (and keeps its scheduled id; a
-                    # later revive can still reattach it).
-                    alive_host[: topo.num_nodes] = faults_mod.kill_disconnected(
-                        topo, alive_host[: topo.num_nodes]
-                    )
-                else:
-                    # self-healing (topology/repair.py): prune dead endpoints
-                    # from the CSR (rewire additionally re-splices survivors),
-                    # then the policy-conditional partition rule runs against
-                    # the *repaired* adjacency — under rewire the splice has
-                    # already reattached orphans, so stranded survivors stay
-                    # in the computation instead of being executed
-                    from gossipprotocol_tpu.topology import repair as repair_mod
+        # host events (SURVEY.md §5.3 + events/): strike everything due —
+        # several rounds' worth after a resume lands mid-schedule — in
+        # round order through the unified pipeline (kills, revives, edge
+        # churn, repair, one partition pass); the round_limit below
+        # guarantees we stop exactly at the next scheduled event so none
+        # can be skipped
+        if host_events.due(cur_round):
+            state, run_topo, new_step, event_recs, reborn_count = \
+                host_events.fire(state, run_topo, cur_round, rebuild)
+            if new_step is not None:
+                step = new_step
+            for rec in event_recs:
+                metrics.append(rec)
+                tel.metric(rec)
+                if cfg.metrics_callback:
+                    cfg.metrics_callback(rec)
+            if reborn_count and mass_base is not None:
+                # revive_rows overwrote rows with fresh-born (s, w):
+                # the conserved quantity itself legitimately changed
+                # (stranded pre-death mass discarded) — re-anchor the
+                # drift baseline with the same no-op-chunk reduction
+                state, _bs = step(state, -1)
+                _bh = jax.device_get(_bs)
+                mass_base = (_bh["mass_s"], _bh["mass_w"])
 
-                    run_topo, repair_stats = repair_mod.repair_topology(
-                        run_topo, alive_host[: topo.num_nodes], cfg.repair,
-                        run_seed=cfg.seed, event_round=cur_round,
-                        revived=req_revive,
-                    )
-                    alive_host[: topo.num_nodes] = faults_mod.apply_partition_rule(
-                        run_topo, alive_host[: topo.num_nodes], cfg.repair
-                    )
-                alive_host[topo.num_nodes:] = False  # padding rows never live
-                # nodes that actually (re)joined — revive ids that survived
-                # the majority rule — restart from fresh-born state
-                reborn = np.flatnonzero(alive_host & ~before)
-                if reborn.size:
-                    state = revive_rows(state, reborn, cfg, topo.num_nodes)
-                # apply the alive diff on device (scatter), keeping the buffer
-                # XLA-owned — a zero-copy device_put of the numpy array would
-                # feed externally-owned memory into the donating step
-                import jax.numpy as jnp
-
-                newly_dead = np.flatnonzero(before & ~alive_host)
-                alive_dev = state.alive
-                if newly_dead.size:
-                    alive_dev = alive_dev.at[
-                        jnp.asarray(newly_dead, jnp.int32)].set(False)
-                if reborn.size:
-                    alive_dev = alive_dev.at[
-                        jnp.asarray(reborn, jnp.int32)].set(True)
-                if alive_dev.sharding != state.alive.sharding:
-                    # the compiled step expects its input layout unchanged
-                    alive_dev = jax.device_put(alive_dev, state.alive.sharding)
-                state = state._replace(alive=alive_dev)
-
-                if repair_stats is not None:
-                    info: dict = {}
-                    rebuild_s = 0.0
-                    if repair_stats["changed"]:
-                        if rebuild is None:
-                            raise RuntimeError(
-                                "repair event fired but the engine supplied "
-                                "no rebuild hook"
-                            )
-                        # repair must never touch protocol state: push-sum
-                        # mass over every row is conserved *exactly* across
-                        # the device rebuild (float64 host sums of the same
-                        # bits — any drift means the rebuild corrupted or
-                        # re-initialized a buffer)
-                        mass0 = _mass_snapshot(state)
-                        t0r = time.perf_counter()
-                        step, state, info = rebuild(run_topo, state)
-                        rebuild_s = time.perf_counter() - t0r
-                        mass1 = _mass_snapshot(state)
-                        if mass0 != mass1:
-                            raise AssertionError(
-                                f"repair rebuild changed protocol mass: "
-                                f"{mass0} -> {mass1} (policy={cfg.repair}, "
-                                f"round={cur_round})"
-                            )
-                    rec = {
-                        "event": "repair",
-                        "round": cur_round,
-                        "policy": cfg.repair,
-                        "rebuild_s": rebuild_s,
-                        **{k: v for k, v in repair_stats.items()},
-                        **info,
-                    }
-                    metrics.append(rec)
-                    tel.metric(rec)
-                    if cfg.metrics_callback:
-                        cfg.metrics_callback(rec)
-
-                if reborn.size and mass_base is not None:
-                    # revive_rows overwrote rows with fresh-born (s, w):
-                    # the conserved quantity itself legitimately changed
-                    # (stranded pre-death mass discarded) — re-anchor the
-                    # drift baseline with the same no-op-chunk reduction
-                    state, _bs = step(state, -1)
-                    _bh = jax.device_get(_bs)
-                    mass_base = (_bh["mass_s"], _bh["mass_w"])
-
-        next_event = min([*kills, *revives], default=cfg.max_rounds)
+        next_event = host_events.next_round(cfg.max_rounds)
         round_limit = min(cur_round + chunk_rounds, cfg.max_rounds, next_event)
         if budget is not None:
             # stop exactly at the budget so the over-budget record carries
@@ -1722,17 +1657,17 @@ def run_simulation(
     ``initial_state`` resumes from a checkpoint (SURVEY.md §5.4).
     """
     run_topo = topo
-    if cfg.repair != "off" and initial_state is not None:
-        # a repair run's adjacency is a function of (birth topo, schedule,
-        # policy, seed): replay the strike rounds the checkpoint already
-        # lived through so the resumed run continues on the same repaired
-        # graph bitwise (topology/repair.py keys its rng per event round)
-        from gossipprotocol_tpu.topology import repair as repair_mod
+    if (cfg.repair != "off" or cfg.events.has_events) \
+            and initial_state is not None:
+        # the run's adjacency is a function of (birth topo, schedule,
+        # event plan, policy, seed): replay the event rounds the
+        # checkpoint already lived through so the resumed run continues
+        # on the same graph bitwise (churn and repair key their rngs per
+        # event round, never threaded through the run)
+        from gossipprotocol_tpu.events import replay_topology
 
         start_round = int(np.asarray(jax.device_get(initial_state.round)))
-        run_topo = repair_mod.replay_repaired_topology(
-            topo, cfg.schedule, cfg.repair, cfg.seed, start_round
-        )
+        run_topo = replay_topology(topo, cfg, start_round)
     from gossipprotocol_tpu.obs import as_telemetry
 
     tel = as_telemetry(cfg.telemetry)

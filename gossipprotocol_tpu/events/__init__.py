@@ -1,0 +1,46 @@
+"""Unified topology-schedule event engine.
+
+One declarative surface for everything that changes the world at chunk
+boundaries: fault strikes (utils/faults.py), overlay repair
+(topology/repair.py), and edge-level churn — timed ``add_edges`` /
+``remove_edges`` / ``swap_neighbors`` plus a seeded synthetic churn
+generator. The legacy ``--fault-plan``/``--fail-*``/``--repair`` flags
+compile down to this engine; event plans add the new axis on top.
+
+* :mod:`gossipprotocol_tpu.events.plan` — the declarative data model
+  (:class:`EventPlan`, :class:`ChurnSpec`), JSON parsing, churn
+  generation, and edge-event application;
+* :mod:`gossipprotocol_tpu.events.engine` — :class:`HostEvents`, the
+  chunk-boundary pipeline the drive loop executes, and the bitwise
+  resume replay (:func:`replay_topology`).
+"""
+
+from gossipprotocol_tpu.events.plan import (  # noqa: F401
+    CHURN_MODELS,
+    ChurnSpec,
+    EventPlan,
+    apply_edge_events,
+    as_plan,
+    generate_churn,
+    parse_churn_arg,
+    parse_event_plan,
+)
+from gossipprotocol_tpu.events.engine import (  # noqa: F401
+    HostEvents,
+    replay_topology,
+    replay_topology_events,
+)
+
+__all__ = [
+    "CHURN_MODELS",
+    "ChurnSpec",
+    "EventPlan",
+    "HostEvents",
+    "apply_edge_events",
+    "as_plan",
+    "generate_churn",
+    "parse_churn_arg",
+    "parse_event_plan",
+    "replay_topology",
+    "replay_topology_events",
+]

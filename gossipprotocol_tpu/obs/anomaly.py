@@ -81,17 +81,26 @@ def _counter_flags(manifest: Optional[Dict[str, Any]]) -> List[str]:
     """sent = delivered + dropped must hold exactly on push-sum runs with
     no churn (every attempted share either moves mass or is dropped by a
     loss window). Gossip breaks the identity by design (receiver-side
-    suppression is "sent, not delivered"), and dead receivers ignoring
-    shares break it under kill schedules — both are gated out rather
-    than special-cased, so this rule never fires on a healthy run."""
+    suppression is "sent, not delivered"), dead receivers ignoring
+    shares break it under kill schedules, and topology-schedule events
+    (events/) legitimately change per-round sent/delivered totals when a
+    mid-run edge rewrite strands in-flight accounting or the partition
+    rule executes a split-off component — all gated out rather than
+    special-cased, so this rule never fires on a healthy run."""
     if manifest is None:
         return []
     counters = manifest.get("counters")
     cfg = manifest.get("config", {})
     sched = cfg.get("fault_schedule", {})
+    plan = cfg.get("event_plan") or {}
+    has_events = (plan.get("add_events", 0) > 0
+                  or plan.get("remove_events", 0) > 0
+                  or plan.get("swap_events", 0) > 0
+                  or plan.get("churn") is not None)
     if (not counters
             or cfg.get("algorithm") != "push-sum"
-            or sched.get("kill_events", 0) > 0):
+            or sched.get("kill_events", 0) > 0
+            or has_events):
         return []
     sent = int(counters.get("sent", 0))
     delivered = int(counters.get("delivered", 0))

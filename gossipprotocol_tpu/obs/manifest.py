@@ -24,13 +24,14 @@ from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
 # runtime-only fields that either cannot serialize (callbacks, the
 # telemetry hub itself) or are captured in richer form elsewhere
 _SKIP_CONFIG_FIELDS = ("metrics_callback", "telemetry", "fault_schedule",
-                       "fault_plan")
+                       "fault_plan", "event_plan")
 
 
 def config_doc(cfg) -> Dict[str, Any]:
-    """RunConfig -> json-able dict; the fault schedule is folded to its
-    normalized digest + event counts rather than dumped raw (large id
-    lists belong in the fault-plan file, not every manifest)."""
+    """RunConfig -> json-able dict; the fault schedule and event plan
+    are folded to their normalized digests + event counts rather than
+    dumped raw (large id/edge lists belong in the plan files, not every
+    manifest)."""
     doc: Dict[str, Any] = {}
     for f in dataclasses.fields(cfg):
         if f.name in _SKIP_CONFIG_FIELDS:
@@ -47,6 +48,16 @@ def config_doc(cfg) -> Dict[str, Any]:
         "kill_events": len(sched.kills),
         "revive_events": len(sched.revives),
         "loss_windows": len(sched.loss),
+    }
+    plan = cfg.events
+    doc["event_plan"] = {
+        "digest": plan.digest(),
+        "add_events": len(plan.adds),
+        "remove_events": len(plan.removes),
+        "swap_events": len(plan.swaps),
+        "churn": (None if plan.churn is None else
+                  {"rate": plan.churn.rate, "model": plan.churn.model,
+                   "period": int(plan.churn.period)}),
     }
     return doc
 

@@ -350,6 +350,20 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
             f"  {sparkline(losses)}  {losses[-1]:.3e} final\n"
         )
 
+    # topology-schedule events (events/) ---------------------------------
+    churn_recs = [r for r in metrics if r.get("event") == "churn"]
+    if churn_recs:
+        added = sum(int(r.get("edges_added", 0)) for r in churn_recs)
+        removed = sum(int(r.get("edges_removed", 0)) for r in churn_recs)
+        swapped = sum(int(r.get("edges_swapped", 0)) for r in churn_recs)
+        skipped = sum(int(r.get("edges_skipped", 0)) for r in churn_recs)
+        gen = sum(1 for r in churn_recs if r.get("generated"))
+        out.write(
+            f"\nchurn applied: {len(churn_recs)} event round(s)"
+            f" ({gen} generated) — edges +{added} -{removed}"
+            f" ~{swapped} swapped, {skipped} skipped\n"
+        )
+
     # anomalies ----------------------------------------------------------
     flags = anomaly_flags(manifest, metrics, trace)
     if flags:

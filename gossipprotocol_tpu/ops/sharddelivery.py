@@ -1048,11 +1048,13 @@ def patch_shard_push_deliveries(old_topo: Topology, new_topo: Topology,
                                 n_padded: int, num_shards: int,
                                 build_workers: Optional[int] = None,
                                 progress=None):
-    """Incrementally patch stacked push plans for a repaired topology.
+    """Incrementally patch stacked push plans for a rewritten topology.
 
-    A repair event (topology/repair.py) usually touches a handful of
-    rows; only the shards whose owned CSR slice changed need the heavy
-    tile-routing pass. The patch forces the *old* geometry — recovered
+    A topology event — repair (topology/repair.py) or edge churn
+    (events/) — usually touches a handful of rows; only the shards whose
+    owned CSR slice changed need the heavy tile-routing pass. The
+    unified event engine routes every mid-run adjacency change through
+    this same path. The patch forces the *old* geometry — recovered
     class capacities, block capacity, and per-stage cr floors — onto the
     changed shards and splices the rebuilt plans into the stacked
     leaves. This is sound because the compiled trajectory is
@@ -1070,7 +1072,7 @@ def patch_shard_push_deliveries(old_topo: Topology, new_topo: Topology,
     *its* capacities from the new census and produces different tables.
     """
     if old_topo.num_nodes != new_topo.num_nodes:
-        raise ValueError("repair never changes the node count")
+        raise ValueError("topology events never change the node count")
     n = new_topo.num_nodes
     local = n_padded // num_shards
     changed = [

@@ -785,15 +785,15 @@ def run_simulation_sharded(
     from gossipprotocol_tpu.engine.driver import resume_allows_fast
 
     run_topo = topo
-    if cfg.repair != "off" and initial_state is not None:
+    if (cfg.repair != "off" or cfg.events.has_events) \
+            and initial_state is not None:
         # same replay the single-chip engine does: the resumed run must
-        # continue on the repaired adjacency the checkpoint lived through
-        from gossipprotocol_tpu.topology import repair as repair_mod
+        # continue on the adjacency the checkpoint lived through (repair
+        # and churn events alike)
+        from gossipprotocol_tpu.events import replay_topology
 
         start_round = int(np.asarray(jax.device_get(initial_state.round)))
-        run_topo = repair_mod.replay_repaired_topology(
-            topo, cfg.schedule, cfg.repair, cfg.seed, start_round
-        )
+        run_topo = replay_topology(topo, cfg, start_round)
 
     is_pushsum = cfg.algorithm != "gossip"
     routed = (is_pushsum and cfg.fanout == "all"

@@ -195,26 +195,14 @@ def replay_repaired_topology(topo: Topology, schedule, policy: str,
     bitwise, because the repair rng is keyed per event round rather
     than threaded through the run.
     """
-    from gossipprotocol_tpu.utils import faults as faults_mod
+    # delegates to the unified event engine with an empty edge-event
+    # plan: the replay rounds are then exactly the strike rounds, repair
+    # and partition fire at each — bitwise the pre-engine loop
+    from gossipprotocol_tpu.events import engine as events_engine
+    from gossipprotocol_tpu.events.plan import EventPlan
 
     validate_policy(policy)
     if policy == "off":
         return topo
-    birth = topo.birth_alive()
-    alive = (np.ones(topo.num_nodes, bool) if birth is None
-             else np.asarray(birth, bool).copy())
-    out = topo
-    for r in sorted(set(schedule.kills) | set(schedule.revives)):
-        if r >= upto_round:
-            break
-        kills = schedule.kills.get(r)
-        if kills is not None:
-            alive[np.asarray(kills, np.int64)] = False
-        revs = schedule.revives.get(r)
-        revived = (np.asarray(revs, np.int64) if revs is not None
-                   else np.empty(0, np.int64))
-        alive[revived] = True
-        out, _ = repair_topology(out, alive, policy, run_seed=run_seed,
-                                 event_round=r, revived=revived)
-        alive = faults_mod.apply_partition_rule(out, alive, policy)
-    return out
+    return events_engine.replay_topology_events(
+        topo, schedule, EventPlan(), policy, run_seed, upto_round)

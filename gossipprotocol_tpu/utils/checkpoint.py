@@ -68,6 +68,11 @@ TRAJECTORY_FIELDS = (
     # subset every round than a sync run, and the rate/grouping select
     # which subset — resuming under any other clock splices trajectories
     "clock", "activation_rate", "groups",
+    # the topology-schedule event plan (events/) rewrites the adjacency
+    # mid-run exactly like repair: resume replays the remaining events
+    # bitwise only against the same plan. Stored as a content digest —
+    # explicit edge lists can be large (trajectory_meta normalizes it)
+    "event_plan",
 )
 
 
@@ -93,7 +98,10 @@ LEGACY_FIELD_DEFAULTS = {"fanout": "one", "delivery": "scatter",
                          # moot under sync but its default is pinned so
                          # resumes never wildcard a poisson rate onto it)
                          "clock": "sync", "activation_rate": 1.0,
-                         "groups": 1}
+                         "groups": 1,
+                         # pre-events checkpoints ran on a static (or
+                         # repair-only) adjacency: no event plan
+                         "event_plan": "none"}
 
 # Sentinel written for alert_quorum=None (the all-nodes stop rule). None
 # cannot be stored raw: resume validation could not tell "all-nodes run"
@@ -166,6 +174,11 @@ def trajectory_meta(cfg) -> dict:
     meta["fault_schedule"] = faults.as_schedule(
         getattr(cfg, "fault_schedule", None), getattr(cfg, "fault_plan", None)
     ).digest()
+    # likewise the event plan: its digest, "none" for plan-free runs
+    from gossipprotocol_tpu.events import plan as events_plan
+
+    meta["event_plan"] = events_plan.as_plan(
+        getattr(cfg, "event_plan", None)).digest()
     return meta
 
 

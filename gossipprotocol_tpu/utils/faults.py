@@ -22,7 +22,12 @@ declarative timeline of three event kinds:
   reproducible and sharding-invariant.
 
 Kills and revives are host events: the driver stops each jitted chunk
-exactly at the next event round and applies the strike between chunks.
+exactly at the next event round and applies the strike between chunks —
+since the unified topology-schedule engine
+(:mod:`gossipprotocol_tpu.events`) subsumed the inline fault machinery,
+that pipeline is :class:`gossipprotocol_tpu.events.engine.HostEvents`,
+which folds strikes together with edge churn and repair; this module
+stays the declarative schedule model and the partition-rule primitives.
 Loss windows are *device* events: the round kernels compute the active
 drop probability from ``state.round`` against the (static) window table,
 so chunks never need to stop at window boundaries.
@@ -254,6 +259,35 @@ def as_schedule(
         ids = np.asarray(ids, dtype=np.int64)
         kills[r] = np.union1d(kills.get(r, np.empty(0, np.int64)), ids)
     return FaultSchedule.from_events(kills, sched.revives, sched.loss)
+
+
+def merge_schedules(*schedules: Optional[FaultSchedule],
+                    ) -> Optional[FaultSchedule]:
+    """Union several fault schedules (per-round id unions, loss windows
+    concatenated in argument order).
+
+    The CLI merges the legacy ``--fault-plan``/``--fail-*`` schedule with
+    the fault keys an ``--event-plan`` document carries — both compile
+    down to the same engine. Returns None when every input is empty, so
+    plain runs keep the static fast paths.
+    """
+    live = [s for s in schedules if s]
+    if not live:
+        return None
+    if len(live) == 1:
+        return live[0]
+    kills: Dict[int, np.ndarray] = {}
+    revives: Dict[int, np.ndarray] = {}
+    loss: list = []
+    for s in live:
+        for dst, src in ((kills, s.kills), (revives, s.revives)):
+            for r, ids in src.items():
+                r = int(r)
+                dst[r] = np.union1d(
+                    dst.get(r, np.empty(0, np.int64)),
+                    np.asarray(ids, np.int64))
+        loss.extend(s.loss)
+    return FaultSchedule.from_events(kills, revives, tuple(loss))
 
 
 def build_schedule(

@@ -1,0 +1,457 @@
+"""Unified topology-schedule event engine (events/): churn as a
+first-class, bitwise-replayable workload.
+
+The engine subsumes the fault machinery (utils/faults.py) and repair
+(topology/repair.py) and adds edge-level churn: timed add/remove/swap
+events plus a seeded synthetic generator, executed at chunk boundaries
+through one host-event pipeline. The claims pinned here:
+
+* declarative parsing rejects every malformed document loudly (the
+  CLI's exit-2 contract),
+* application semantics (remove -> swap -> add, invalid entries
+  skipped+counted) rebuild canonical CSRs,
+* generated churn is a pure function of (seed, round, adjacency),
+* the legacy fault spellings and an event plan's kill/revive keys
+  compile down to the same trajectory bitwise,
+* a mid-schedule resume replays the remaining events bitwise, and
+* a churn schedule is single-chip-equal at 2/4/8 shards.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.events import (
+    ChurnSpec,
+    EventPlan,
+    apply_edge_events,
+    generate_churn,
+    parse_churn_arg,
+    parse_event_plan,
+    replay_topology,
+)
+from gossipprotocol_tpu.parallel import run_simulation_sharded
+from gossipprotocol_tpu.utils.faults import FaultSchedule
+
+
+def _edges(topo):
+    off = np.asarray(topo.offsets)
+    idx = np.asarray(topo.indices)
+    u = np.repeat(np.arange(topo.num_nodes), np.diff(off))
+    return {(min(a, b), max(a, b)) for a, b in zip(u.tolist(), idx.tolist())}
+
+
+# ----------------------------------------------------- parsing + validation
+
+
+def test_parse_event_plan_full_document():
+    plan, sched = parse_event_plan({
+        "add_edges": [{"round": 40, "edges": [[0, 5], [3, 9]]},
+                      {"round": 40, "edges": [[1, 7]]}],
+        "remove_edges": [{"round": 60, "edges": [[1, 2]]}],
+        "swap_neighbors": [{"round": 80, "pairs": [[[0, 1], [2, 3]]]}],
+        "churn": {"rate": 0.02, "model": "edge", "period": 25},
+        "kill": [{"round": 10, "ids": [1, 2]}],
+        "revive": [{"round": 30, "ids": [1, 2]}],
+        "loss": [{"start": 5, "stop": 25, "prob": 0.2}],
+    }, num_nodes=16)
+    assert plan.explicit_rounds() == (40, 60, 80)
+    assert plan.adds[40].shape == (3, 2)  # same-round entries concatenate
+    assert plan.swaps[80].shape == (1, 4)
+    assert plan.churn == ChurnSpec(0.02, "edge", 25)
+    # the fault keys land in a FaultSchedule — one document, one engine
+    assert sorted(sched.kills) == [10] and sorted(sched.revives) == [30]
+    assert len(sched.loss) == 1
+
+
+@pytest.mark.parametrize("doc,msg", [
+    ([1, 2], "JSON object"),
+    ({"bogus_key": []}, "unknown key"),
+    ({"add_edges": {"round": 1}}, "list of events"),
+    ({"add_edges": [{"edges": [[0, 1]]}]}, "round"),
+    ({"add_edges": [{"round": 4}]}, "edges"),
+    ({"add_edges": [{"round": 4, "edges": [[0, 1, 2]]}]}, "edges"),
+    ({"add_edges": [{"round": 4, "edges": []}]}, "empty"),
+    ({"add_edges": [{"round": -2, "edges": [[0, 1]]}]}, "negative"),
+    ({"remove_edges": [{"round": 4, "edges": [[0, 99]]}]}, "out of range"),
+    ({"swap_neighbors": [{"round": 4, "edges": [[0, 1]]}]}, "pairs"),
+    ({"swap_neighbors": [{"round": 4, "pairs": [[[0, 1]]]}]}, "pairs"),
+    ({"churn": {"rate": 0.1}}, "model"),
+    ({"churn": {"rate": 0.1, "model": "teleport"}}, "model"),
+    ({"churn": {"rate": 0.0, "model": "edge"}}, "rate"),
+    ({"churn": {"rate": 0.1, "model": "edge", "period": 0}}, "period"),
+    ({"churn": {"rate": 0.1, "model": "edge", "phase": 3}}, "unknown"),
+])
+def test_parse_event_plan_rejects_malformed(doc, msg):
+    with pytest.raises(ValueError, match=msg):
+        parse_event_plan(doc, num_nodes=16)
+
+
+def test_parse_churn_arg():
+    assert parse_churn_arg("0.05,edge") == ChurnSpec(0.05, "edge", 10)
+    assert parse_churn_arg("0.2, swap, 7") == ChurnSpec(0.2, "swap", 7)
+    for bad in ("0.05", "x,edge", "0.05,edge,z", "0.05,edge,1,2"):
+        with pytest.raises(ValueError):
+            parse_churn_arg(bad)
+
+
+def test_plan_digest_stable_and_none():
+    assert EventPlan().digest() == "none"
+    p1 = EventPlan.from_events(adds={4: [[0, 1]]},
+                               churn=ChurnSpec(0.1, "edge", 5))
+    p2 = EventPlan.from_events(adds={4: [(0, 1)]},
+                               churn=ChurnSpec(0.1, "edge", 5))
+    assert p1.digest() == p2.digest() != "none"
+    assert p1.digest() != EventPlan.from_events(adds={5: [[0, 1]]}).digest()
+    assert (p1.digest()
+            != dataclasses.replace(p1, churn=ChurnSpec(0.2, "edge", 5))
+            .digest())
+
+
+def test_next_churn_round():
+    plan = EventPlan.from_events(churn=ChurnSpec(0.1, "edge", 10))
+    assert plan.next_churn_round(0) == 10   # churn never fires at round 0
+    assert plan.next_churn_round(10) == 10
+    assert plan.next_churn_round(11) == 20
+    assert EventPlan().next_churn_round(5) is None
+
+
+# ------------------------------------------------------------- application
+
+
+def test_apply_edge_events_semantics():
+    topo = build_topology("line", 8)  # edges (i, i+1)
+    out, stats = apply_edge_events(
+        topo,
+        removes=[[3, 4], [5, 7]],       # (5,7) absent -> skipped
+        swaps=[[0, 1, 5, 6]],           # -> (0,6) + (5,1)
+        adds=[[2, 7], [2, 2], [1, 2]],  # self-loop + existing -> skipped
+    )
+    assert stats == {"changed": True, "edges_added": 1, "edges_removed": 1,
+                     "edges_swapped": 1, "edges_skipped": 3}
+    expect = (_edges(topo) - {(3, 4), (0, 1), (5, 6)}) | {
+        (0, 6), (1, 5), (2, 7)}
+    assert _edges(out) == expect
+    # untouched plan -> same object, no rebuild for the caller to pay
+    same, st0 = apply_edge_events(topo, removes=[[5, 7]])
+    assert same is topo and st0["changed"] is False
+
+
+def test_apply_edge_events_canonical_order_independent():
+    topo = build_topology("imp3D", 27)
+    adds = [[0, 13], [2, 22], [5, 19]]
+    a = apply_edge_events(topo, adds=adds)[0]
+    b = apply_edge_events(topo, adds=adds[::-1])[0]
+    np.testing.assert_array_equal(np.asarray(a.offsets),
+                                  np.asarray(b.offsets))
+    np.testing.assert_array_equal(np.asarray(a.indices),
+                                  np.asarray(b.indices))
+
+
+def test_apply_edge_events_rejects_implicit_full():
+    topo = build_topology("full", 8)
+    with pytest.raises(ValueError, match="explicit edge list"):
+        apply_edge_events(topo, adds=[[0, 1]])
+
+
+def test_generate_churn_deterministic_and_keyed_per_round():
+    topo = build_topology("imp3D", 64)
+    spec = ChurnSpec(0.1, "edge", 10)
+    a = generate_churn(topo, spec, run_seed=7, event_round=10)
+    b = generate_churn(topo, spec, run_seed=7, event_round=10)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    c = generate_churn(topo, spec, run_seed=7, event_round=20)
+    assert not np.array_equal(a[0], c[0])  # fresh draws per event round
+    # removals hit existing edges; additions are fresh non-edges
+    edges = _edges(topo)
+    assert all((min(u, v), max(u, v)) in edges for u, v in a[0].tolist())
+    assert all((min(u, v), max(u, v)) not in edges for u, v in a[1].tolist())
+
+
+def test_generate_churn_swap_preserves_degrees():
+    topo = build_topology("imp3D", 64)
+    _, _, quads = generate_churn(topo, ChurnSpec(0.1, "swap", 10),
+                                 run_seed=3, event_round=10)
+    assert quads.size
+    out, stats = apply_edge_events(topo, swaps=quads)
+    assert stats["edges_swapped"] + stats["edges_skipped"] == len(quads)
+    if stats["changed"]:
+        np.testing.assert_array_equal(np.asarray(out.degree),
+                                      np.asarray(topo.degree))
+
+
+def test_replay_topology_matches_sequential_application():
+    """Resume replay reconstructs exactly the adjacency the live engine
+    built by applying each round's events in order."""
+    topo = build_topology("imp3D", 27)
+    plan = EventPlan.from_events(
+        adds={6: [[0, 13]]}, removes={9: [[1, 2]]},
+        churn=ChurnSpec(0.05, "edge", 8))
+    cfg = RunConfig(algorithm="push-sum", fanout="all", seed=7,
+                    event_plan=plan)
+    expect = topo
+    for r in (6, 8, 9, 16):
+        rem = plan.removes.get(r)
+        add = plan.adds.get(r)
+        if r % 8 == 0:
+            g_rem, g_add, _ = generate_churn(
+                expect, plan.churn, run_seed=7, event_round=r)
+            rem = g_rem if rem is None else np.concatenate(
+                [np.asarray(rem).reshape(-1, 2), g_rem])
+            add = g_add if add is None else np.concatenate(
+                [np.asarray(add).reshape(-1, 2), g_add])
+        expect = apply_edge_events(expect, removes=rem, adds=add)[0]
+    got = replay_topology(topo, cfg, upto_round=17)
+    assert _edges(got) == _edges(expect)
+    # a checkpoint at round C reflects events r < C, never r == C: the
+    # replay to round 9 stops after the round-6 add and round-8 churn,
+    # with the round-9 removal still pending
+    mid = topo
+    for r in (6, 8):
+        rem, add = None, plan.adds.get(r)
+        if r == 8:
+            rem, g_add, _ = generate_churn(mid, plan.churn, run_seed=7,
+                                           event_round=8)
+            add = g_add
+        mid = apply_edge_events(mid, removes=rem, adds=add)[0]
+    assert _edges(replay_topology(topo, cfg, upto_round=9)) == _edges(mid)
+
+
+# --------------------------------------------- engine: equivalence + runs
+
+
+def test_event_plan_kills_match_legacy_schedule_bitwise():
+    """The plan's kill/revive keys and the legacy FaultSchedule spelling
+    compile down to the same engine — identical trajectories, bitwise."""
+    topo = build_topology("imp3D", 64)
+    legacy = FaultSchedule.from_events(kills={5: [3, 4, 5]},
+                                       revives={20: [3, 4]})
+    _, from_plan = parse_event_plan({
+        "kill": [{"round": 5, "ids": [3, 4, 5]}],
+        "revive": [{"round": 20, "ids": [3, 4]}],
+    }, num_nodes=64)
+    r1 = run_simulation(topo, RunConfig(algorithm="gossip", seed=0,
+                                        fault_schedule=legacy,
+                                        max_rounds=50_000))
+    r2 = run_simulation(topo, RunConfig(algorithm="gossip", seed=0,
+                                        fault_schedule=from_plan,
+                                        max_rounds=50_000))
+    assert r1.rounds == r2.rounds and r1.converged
+    np.testing.assert_array_equal(np.asarray(r1.final_state.counts),
+                                  np.asarray(r2.final_state.counts))
+    np.testing.assert_array_equal(np.asarray(r1.final_state.alive),
+                                  np.asarray(r2.final_state.alive))
+
+
+def test_churn_run_converges_and_records():
+    topo = build_topology("imp3D", 64)
+    plan = EventPlan.from_events(
+        adds={6: [[0, 33], [2, 41]]}, removes={10: [[0, 1]]},
+        churn=ChurnSpec(0.05, "edge", 15))
+    cfg = RunConfig(algorithm="push-sum", fanout="all", seed=3,
+                    predicate="global", tol=1e-3, event_plan=plan,
+                    max_rounds=400)
+    res = run_simulation(topo, cfg)
+    assert res.converged
+    churn = [m for m in res.metrics if m.get("event") == "churn"]
+    assert churn and churn[0]["round"] == 6
+    assert any(c["generated"] for c in churn)
+    assert all(c["changed"] == (c["edges_added"] + c["edges_removed"]
+                                + c["edges_swapped"] > 0) for c in churn)
+    # push-sum mass survived every event rebuild: the mean of the
+    # default init (i/n) is exact
+    s = np.asarray(res.final_state.s, np.float64)
+    w = np.asarray(res.final_state.w, np.float64)
+    # f32 state: drift stays at summation-ULP scale across every rebuild
+    np.testing.assert_allclose(s.sum() / w.sum(), (64 - 1) / 2.0 / 64,
+                               rtol=1e-6)
+
+
+def test_mid_schedule_resume_replays_bitwise():
+    """A resume from a checkpoint taken mid-schedule must land on the
+    same trajectory: the remaining events replay bitwise (explicit
+    events literal, churn counter-keyed per round) on the replayed
+    adjacency."""
+    from gossipprotocol_tpu.engine import resume_simulation
+
+    topo = build_topology("imp3D", 64)
+    plan = EventPlan.from_events(
+        adds={6: [[0, 33]]}, removes={24: [[1, 2]]},
+        churn=ChurnSpec(0.05, "edge", 9))
+    sched = FaultSchedule.from_events(kills={12: [7]})
+    cfg = RunConfig(algorithm="push-sum", fanout="all", seed=3,
+                    predicate="global", tol=1e-3, event_plan=plan,
+                    fault_schedule=sched, max_rounds=48)
+    full = run_simulation(topo, cfg)
+
+    # checkpoint between churn events (after rounds 6, 9, 12 fired; the
+    # 18+ tail still pending), resume to the same budget
+    part = run_simulation(topo, dataclasses.replace(cfg, max_rounds=16))
+    assert not part.converged
+    resumed = resume_simulation(topo, cfg, part.final_state)
+    assert resumed.rounds == full.rounds > 16
+    np.testing.assert_array_equal(np.asarray(full.final_state.s),
+                                  np.asarray(resumed.final_state.s))
+    np.testing.assert_array_equal(np.asarray(full.final_state.w),
+                                  np.asarray(resumed.final_state.w))
+    np.testing.assert_array_equal(np.asarray(full.final_state.alive),
+                                  np.asarray(resumed.final_state.alive))
+
+
+@pytest.mark.parametrize("devices", [2, 4, 8])
+def test_churn_sharded_bitwise(devices):
+    """A churn schedule (explicit adds/removes + generated churn) is
+    single-chip-equal at every mesh size: gossip counts are integers, so
+    equality is bitwise, and every sharded rebuild must route through
+    the same replayed adjacencies."""
+    topo = build_topology("imp3D", 64)
+    plan = EventPlan.from_events(
+        adds={6: [[0, 33], [2, 41]]}, removes={11: [[0, 1]]},
+        churn=ChurnSpec(0.05, "edge", 15))
+    cfg = RunConfig(algorithm="gossip", seed=0, event_plan=plan,
+                    max_rounds=50_000)
+    r1 = run_simulation(topo, cfg)
+    rd = run_simulation_sharded(topo, cfg, num_devices=devices)
+    assert r1.rounds == rd.rounds and r1.converged and rd.converged
+    np.testing.assert_array_equal(np.asarray(r1.final_state.counts),
+                                  np.asarray(rd.final_state.counts))
+    np.testing.assert_array_equal(np.asarray(r1.final_state.alive),
+                                  np.asarray(rd.final_state.alive))
+
+
+def test_event_plan_rejected_for_incompatible_modes():
+    plan = EventPlan.from_events(adds={4: [[0, 1]]})
+    with pytest.raises(ValueError, match="reference"):
+        RunConfig(algorithm="gossip", semantics="reference",
+                  event_plan=plan)
+    with pytest.raises(ValueError, match="accel"):
+        RunConfig(algorithm="push-sum", fanout="all", accel="chebyshev",
+                  event_plan=plan)
+    with pytest.raises(ValueError, match="adjacency never changes"):
+        RunConfig(algorithm="push-sum", fanout="one", delivery="invert",
+                  event_plan=plan)
+    # implicit-full topologies have no CSR to rewrite
+    topo = build_topology("full", 16)
+    with pytest.raises(ValueError, match="explicit edge list"):
+        run_simulation(topo, RunConfig(algorithm="gossip",
+                                       event_plan=plan, max_rounds=8))
+
+
+# ------------------------------------------------- checkpoint + CLI surface
+
+
+def test_event_plan_is_a_trajectory_field():
+    from gossipprotocol_tpu.utils import checkpoint as ckpt
+
+    plan = EventPlan.from_events(adds={4: [[0, 1]]})
+    cfg = RunConfig(algorithm="gossip", event_plan=plan)
+    meta = ckpt.trajectory_meta(cfg)
+    assert meta["event_plan"] == plan.digest()
+    plain = ckpt.trajectory_meta(RunConfig(algorithm="gossip"))
+    assert plain["event_plan"] == "none"
+    # a pre-events checkpoint necessarily ran without a plan: pinned
+    # default, not a wildcard
+    assert ckpt.field_matches({}, "event_plan", "none")
+    assert not ckpt.field_matches({}, "event_plan", plan.digest())
+    assert ckpt.field_matches({"event_plan": plan.digest()},
+                              "event_plan", plan.digest())
+    assert not ckpt.field_matches({"event_plan": plan.digest()},
+                                  "event_plan", "none")
+
+
+def run_cli(args, capsys):
+    from gossipprotocol_tpu.cli import main
+
+    code = main(args)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+@pytest.mark.parametrize("doc", [
+    "not json at all {",
+    json.dumps([1, 2]),
+    json.dumps({"bogus": 1}),
+    json.dumps({"add_edges": [{"round": 4}]}),
+    json.dumps({"add_edges": [{"round": 4, "edges": []}]}),
+    json.dumps({"remove_edges": [{"round": 4, "edges": [[0, 99]]}]}),
+    json.dumps({"swap_neighbors": [{"round": 4, "pairs": [[0, 1]]}]}),
+    json.dumps({"churn": {"rate": 5, "model": "edge"}}),
+])
+def test_cli_malformed_event_plan_exits_2(tmp_path, capsys, doc):
+    f = tmp_path / "plan.json"
+    f.write_text(doc)
+    code, _, err = run_cli([
+        "27", "imp3D", "push-sum", "--backend", "cpu",
+        "--event-plan", str(f), "--max-rounds", "8", "--quiet",
+    ], capsys)
+    assert code == 2 and "event plan invalid" in err
+
+
+def test_cli_churn_sugar_exit2_matrix(tmp_path, capsys):
+    for bad in ("0.05", "x,edge", "0.05,teleport", "0.05,edge,0"):
+        code, _, err = run_cli([
+            "27", "imp3D", "push-sum", "--backend", "cpu",
+            "--churn", bad, "--max-rounds", "8", "--quiet",
+        ], capsys)
+        assert code == 2 and "event plan invalid" in err, bad
+    # double churn spec (flag + plan) is ambiguous -> exit 2
+    f = tmp_path / "plan.json"
+    f.write_text(json.dumps(
+        {"churn": {"rate": 0.1, "model": "edge"}}))
+    code, _, err = run_cli([
+        "27", "imp3D", "push-sum", "--backend", "cpu",
+        "--event-plan", str(f), "--churn", "0.1,edge",
+        "--max-rounds", "8", "--quiet",
+    ], capsys)
+    assert code == 2 and "event plan invalid" in err
+    # missing file reports cleanly too
+    code, _, err = run_cli([
+        "27", "imp3D", "push-sum", "--backend", "cpu",
+        "--event-plan", str(tmp_path / "nope.json"),
+        "--max-rounds", "8", "--quiet",
+    ], capsys)
+    assert code == 2 and "event plan invalid" in err
+    # the implicit complete graph has no CSR to rewrite
+    code, _, err = run_cli([
+        "27", "full", "push-sum", "--backend", "cpu",
+        "--churn", "0.1,edge", "--max-rounds", "8", "--quiet",
+    ], capsys)
+    assert code == 2 and "event plan invalid" in err
+
+
+def test_cli_resume_refuses_event_plan_switch(tmp_path, capsys):
+    """Resuming under a different event plan would splice two topology
+    histories — refused like a seed mismatch; the matching plan (and
+    only it) resumes."""
+    plan_a = tmp_path / "a.json"
+    plan_a.write_text(json.dumps(
+        {"add_edges": [{"round": 6, "edges": [[0, 33]]}]}))
+    plan_b = tmp_path / "b.json"
+    plan_b.write_text(json.dumps(
+        {"add_edges": [{"round": 6, "edges": [[0, 34]]}]}))
+    ckdir = str(tmp_path / "ck")
+    base = ["64", "imp3D", "push-sum", "--backend", "cpu", "--seed", "7",
+            "--fanout", "all", "--predicate", "global", "--tol", "1e-3"]
+    code, _, _ = run_cli([
+        *base, "--event-plan", str(plan_a),
+        "--checkpoint-dir", ckdir, "--checkpoint-every", "1",
+        "--chunk-rounds", "8", "--max-rounds", "16", "--quiet",
+    ], capsys)
+    assert code == 1  # round budget hit mid-run, checkpoint written
+    code, _, err = run_cli([
+        *base, "--event-plan", str(plan_b), "--resume", ckdir, "--quiet",
+    ], capsys)
+    assert code == 2 and "event_plan" in err
+    code, _, err = run_cli([
+        *base, "--resume", ckdir, "--quiet",
+    ], capsys)
+    assert code == 2 and "event_plan" in err
+    code, _, err = run_cli([
+        *base, "--event-plan", str(plan_a), "--resume", ckdir,
+        "--max-rounds", "200000", "--quiet",
+    ], capsys)
+    assert code == 0, err
