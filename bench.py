@@ -121,6 +121,16 @@ def _delivery_microbench() -> None:
 
     Knobs: ``BENCH_DELIVERY_NODES`` (default 200k), ``BENCH_DELIVERY_ITERS``
     (timed matvecs per path, default 30).
+
+    A second section sweeps the round-loop megakernel over K ∈
+    {1, 4, 16, 64} rounds per kernel launch (``ops/megakernel.py``) and
+    reports ``per_round_ms`` for each — the number the TPU campaign
+    checks for monotone decrease. Runs only when the pallas gather plan
+    is VMEM-resident (the megakernel's eligibility rule); iterations via
+    ``BENCH_KSWEEP_ITERS`` (default 3 interpreted / 10 on TPU, K=64
+    interpreted is ~64 matvecs per timed call). ``BENCH_PAYLOAD_WIRE``
+    stamps the wire column (f32/bf16/int8) into the record so one
+    campaign certifies kernel, overlap, and wire together.
     """
     import jax
     import jax.numpy as jnp
@@ -141,6 +151,7 @@ def _delivery_microbench() -> None:
 
     paths = {}
     outputs = {}
+    pallas_d = None
     for name, build, to_dev in (
         ("routed", routed_mod.build_routed_delivery, routed_mod.to_device),
         ("pallas", pallas_mod.build_pallas_delivery, pallas_mod.to_device),
@@ -171,10 +182,52 @@ def _delivery_microbench() -> None:
         }
         if name == "pallas":
             paths[name]["gather_mode"] = d.gather_pre.mode
+            pallas_d = d
 
     # correctness oracle before any speedup claim
     np.testing.assert_array_equal(outputs["routed"][0], outputs["pallas"][0])
     np.testing.assert_array_equal(outputs["routed"][1], outputs["pallas"][1])
+
+    # --- K-sweep: rounds fused per kernel launch -------------------------
+    wire = os.environ.get("BENCH_PAYLOAD_WIRE", "f32")
+    gather_mode = pallas_d.gather_pre.mode
+    ksweep = {}
+    if gather_mode == "resident" and pallas_d.gather_out.mode == "resident":
+        from gossipprotocol_tpu.ops.megakernel import (
+            build_megakernel_delivery,
+            make_megakernel_round,
+        )
+        from gossipprotocol_tpu.protocols.state import pushsum_init
+
+        mk = build_megakernel_delivery(pallas_d)
+        state0 = pushsum_init(topo.num_nodes)
+        k_iters = int(os.environ.get("BENCH_KSWEEP_ITERS",
+                                     3 if interpret else 10))
+        key = jax.random.PRNGKey(0)
+        for k in (1, 4, 16, 64):
+            # streak target past any horizon: the in-kernel freeze never
+            # fires, so every launch really executes K rounds
+            core = make_megakernel_round(
+                n=topo.num_nodes, rounds_per_kernel=k, eps=1e-6,
+                streak_target=2 ** 30, predicate="delta", tol=1e-4,
+                interpret=interpret)
+            fn = jax.jit(lambda st, core=core: core(st, mk, key))
+            t0 = time.perf_counter()
+            st = fn(state0)
+            jax.block_until_ready(st)
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(k_iters):
+                st = fn(st)
+            jax.block_until_ready(st)
+            total_s = time.perf_counter() - t0
+            ksweep[f"K{k}"] = {
+                "rounds_per_kernel": k,
+                "per_round_ms": round(total_s / (k_iters * k) * 1e3, 3),
+                "compile_s": round(compile_s, 3),
+                "gather_mode": gather_mode,
+                "payload_wire": wire,
+            }
 
     print(json.dumps({
         "metric": "delivery_matvec_imp3d",
@@ -184,9 +237,11 @@ def _delivery_microbench() -> None:
         "device_kind": jax.devices()[0].device_kind,
         "interpret": interpret,
         "bitwise_equal": True,
+        "payload_wire": wire,
         "pallas_vs_routed": round(
             paths["routed"]["matvec_ms"] / paths["pallas"]["matvec_ms"], 2),
         "paths": paths,
+        "megakernel_ksweep": ksweep or None,
         "peak_rss_bytes": _peak_rss(),
     }))
 

@@ -134,6 +134,9 @@ def _build_config(args, algo, fault_schedule, jnp, event_plan=None,
         edge_chunks=args.edge_chunks,
         delivery=args.delivery,
         routed_design=args.routed_design or "push",
+        rounds_per_kernel=args.rounds_per_kernel,
+        payload_wire=args.payload_wire,
+        exchange_overlap=args.exchange_overlap,
         plan_cache=args.plan_cache,
         build_workers=args.build_workers,
         value_mode=args.value_mode,
@@ -304,7 +307,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "graph mixing time (required for hub-heavy graphs "
                         "like power-law at scale)")
     p.add_argument("--delivery",
-                   choices=["scatter", "invert", "routed", "pallas"],
+                   choices=["scatter", "invert", "routed", "pallas",
+                            "megakernel"],
                    default="scatter",
                    help="push-sum delivery. fanout-one: segment_sum "
                         "scatter-add, or 'invert' — the receiver-side "
@@ -323,7 +327,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "geometry, bitwise equal to 'routed'); under "
                         "--devices N the push design's all_to_all becomes "
                         "per-destination async remote-copy DMAs — see "
-                        "README 'Performance'")
+                        "README 'Performance'. 'megakernel': the pallas "
+                        "path with the whole protocol round fused into "
+                        "one VMEM-resident kernel, running "
+                        "--rounds-per-kernel rounds per launch "
+                        "(single-chip, all-alive, synchronous)")
     p.add_argument("--routed-design", choices=["pull", "push"], default=None,
                    help="sharded routed delivery variant (requires "
                         "--delivery routed with --devices N). 'push' "
@@ -333,6 +341,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "'pull': the round-5 design — all_gather the full "
                         "state, per-shard O(n) plan_in tables; escape "
                         "hatch for graphs the push compiler rejects")
+    p.add_argument("--rounds-per-kernel", type=_positive_int, default=1,
+                   metavar="K",
+                   help="protocol rounds fused into one kernel launch "
+                        "(requires --delivery pallas or megakernel; "
+                        "--delivery megakernel with K=1 is bitwise the "
+                        "pallas path per round). Amortizes launch and "
+                        "HBM round-trip overhead; convergence "
+                        "trajectories are identical for every K because "
+                        "the in-kernel loop freezes once the predicate "
+                        "fires")
+    p.add_argument("--payload-wire", choices=["f32", "bf16", "int8"],
+                   default="f32",
+                   help="wire format for the sharded routed-push "
+                        "edge-share exchange (requires --devices N with "
+                        "--delivery routed/pallas, push design). bf16 "
+                        "halves and int8 quarters exchange bytes per "
+                        "round; accumulation stays f32 on both ends. "
+                        "f32 (default) is the bitwise path")
+    p.add_argument("--exchange-overlap", action="store_true",
+                   help="double-buffered DMA ring for the sharded "
+                        "routed-push exchange: per-destination remote "
+                        "copies overlap with the waits instead of "
+                        "start-all-then-wait (requires --devices N with "
+                        "--delivery routed/pallas, push design; "
+                        "bitwise-equal payload bytes)")
     p.add_argument("--plan-cache", type=str, default=None, metavar="DIR",
                    help="routed-delivery plan cache directory (default "
                         "$GOSSIP_TPU_PLAN_CACHE or "
@@ -771,11 +804,31 @@ def main(argv=None) -> int:
                 "pallas, push-only) AND --devices N (got delivery=%r, "
                 "devices=%d)" % (cfg.delivery, args.devices)
             )
-        if cfg.delivery in ("routed", "pallas") and topo.implicit_full:
+        if (cfg.delivery in ("routed", "pallas", "megakernel")
+                and topo.implicit_full):
             raise ValueError(
                 f"delivery='{cfg.delivery}' needs an explicit edge list; "
                 "the complete graph has none (diffusion on K_n mixes in "
                 "one round via two reductions) — use delivery='scatter'"
+            )
+        if args.devices > 1 and (
+                cfg.delivery == "megakernel" or cfg.rounds_per_kernel > 1):
+            raise ValueError(
+                "the round-loop megakernel is single-chip only (the "
+                "in-kernel round has no exchange step) — drop --devices "
+                "or --rounds-per-kernel"
+            )
+        if cfg.payload_wire != "f32" and args.devices <= 1:
+            raise ValueError(
+                "--payload-wire compresses the sharded edge-share "
+                "exchange; a single-chip run has no wire — drop the flag "
+                "or add --devices N"
+            )
+        if cfg.exchange_overlap and args.devices <= 1:
+            raise ValueError(
+                "--exchange-overlap rewrites the sharded exchange; a "
+                "single-chip run has no exchange — drop the flag or add "
+                "--devices N"
             )
         if cfg.workload == "gala" and topo.num_nodes % cfg.groups:
             # surfaced here so the divisibility failure is a clean CLI

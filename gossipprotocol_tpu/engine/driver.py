@@ -110,6 +110,30 @@ class RunConfig:
                                    # are bitwise-equal to the single-chip
                                    # routed delivery
                                    # (tests/test_pushdelivery.py)
+    rounds_per_kernel: int = 1     # K rounds fused into one pallas_call
+                                   # (ops/megakernel.py): K=1 on
+                                   # delivery='pallas' is the literal
+                                   # per-round path; K>1 (or
+                                   # delivery='megakernel') runs K-round
+                                   # super-steps with convergence checked
+                                   # in-kernel — the round count can
+                                   # overshoot max_rounds/chunk bounds by
+                                   # < K, never past convergence.
+                                   # Trajectory field: K>1 changes the
+                                   # compiled round granularity
+    payload_wire: str = "f32"      # sharded edge-share slab wire dtype:
+                                   # "f32" (bitwise default) | "bf16" |
+                                   # "int8" (quantized on the wire, f32
+                                   # accumulation — ops/sharddelivery.py).
+                                   # Trajectory field: lossy wires change
+                                   # the received sums
+    exchange_overlap: bool = False # sharded push exchange on the
+                                   # double-buffered DMA ring
+                                   # (pallas_exchange overlap=True)
+                                   # instead of start-all-then-wait. NOT
+                                   # a trajectory field: the ring moves
+                                   # the identical slab — bitwise-equal
+                                   # transport (tests/test_pallasdelivery)
     value_mode: str = "scaled"     # push-sum init: "scaled" (i/N) | "index" (i)
     payload_dim: int = 1           # push-sum payload width d: 1 = the
                                    # scalar (s, w) protocol (bitwise the
@@ -255,7 +279,9 @@ class RunConfig:
                 "edge_chunks applies to fanout-all diffusion only (the "
                 "other senders have no per-edge intermediates to slice)"
             )
-        if self.edge_chunks > 1 and self.delivery in ("routed", "pallas"):
+        if self.edge_chunks > 1 and self.delivery in (
+            "routed", "pallas", "megakernel"
+        ):
             raise ValueError(
                 "edge_chunks applies to the scatter delivery; the routed "
                 "and pallas plans stream at fixed memory already"
@@ -266,9 +292,10 @@ class RunConfig:
                 "single-target send IS the reference's accidental behavior "
                 "(Program.fs:128) that the diffusion variant replaces"
             )
-        if self.delivery not in ("scatter", "invert", "routed", "pallas"):
+        if self.delivery not in ("scatter", "invert", "routed", "pallas",
+                                 "megakernel"):
             raise ValueError("delivery must be 'scatter', 'invert', "
-                             "'routed', or 'pallas'")
+                             "'routed', 'pallas', or 'megakernel'")
         sched = self.schedule.validate()  # structural check, loud + early
         from gossipprotocol_tpu.topology.repair import validate_policy
 
@@ -304,10 +331,12 @@ class RunConfig:
                 "written this run",
                 stacklevel=2,
             )
-        if self.delivery in ("routed", "pallas"):
+        if self.delivery in ("routed", "pallas", "megakernel"):
             # pallas shares the routed contract exactly: it is the same
             # plan geometry with the copy chain fused into gather
-            # kernels (ops/pallasdelivery.py), held bitwise equal
+            # kernels (ops/pallasdelivery.py), held bitwise equal;
+            # megakernel is the pallas geometry with K rounds looped
+            # inside one kernel (ops/megakernel.py)
             if self.algorithm != "push-sum" or self.fanout != "all":
                 raise ValueError(
                     f"delivery='{self.delivery}' applies to fanout-all "
@@ -341,6 +370,89 @@ class RunConfig:
             )
         if self.routed_design not in ("push", "pull"):
             raise ValueError("routed_design must be 'push' or 'pull'")
+        if self.rounds_per_kernel < 1:
+            raise ValueError("rounds_per_kernel must be >= 1")
+        if self.rounds_per_kernel > 1 and self.delivery not in (
+            "pallas", "megakernel"
+        ):
+            raise ValueError(
+                "rounds_per_kernel > 1 loops rounds inside the fused "
+                "Pallas kernel — it requires delivery='pallas' (or "
+                "'megakernel'); the other deliveries dispatch one round "
+                "per launch by construction"
+            )
+        if self.delivery == "megakernel" or self.rounds_per_kernel > 1:
+            # the in-kernel round loop replays the all-alive synchronous
+            # scalar round only: everything the kernel would have to
+            # re-derive per round (activation draws, loss masks, payload
+            # loops, learner steps, mid-run adjacency rewrites) stays on
+            # the per-round paths
+            if self.clock != "sync":
+                raise ValueError(
+                    "the round-loop megakernel replays the synchronous "
+                    "round in-register; poisson activation draws fresh "
+                    "masks per round — use clock='sync' or "
+                    "rounds_per_kernel=1"
+                )
+            if self.payload_dim != 1:
+                raise ValueError(
+                    "the round-loop megakernel carries the scalar (s, w) "
+                    "state in VMEM; vector payloads need the per-round "
+                    "pallas path — use delivery='pallas' with "
+                    "rounds_per_kernel=1"
+                )
+            if self.workload != "avg":
+                raise ValueError(
+                    "the round-loop megakernel fuses the plain averaging "
+                    "round; SGP/GALA inject gradient mass between rounds "
+                    "— use delivery='pallas' with rounds_per_kernel=1"
+                )
+            if sched or plan or self.repair != "off":
+                raise ValueError(
+                    "the round-loop megakernel compiles K rounds against "
+                    "a fixed live topology; fault strikes, loss windows, "
+                    "topology events and repair all need the per-round "
+                    "engine — use delivery='pallas' with "
+                    "rounds_per_kernel=1"
+                )
+            if (self.chunk_rounds is not None
+                    and self.chunk_rounds % self.rounds_per_kernel):
+                raise ValueError(
+                    f"chunk_rounds ({self.chunk_rounds}) must be a "
+                    f"multiple of rounds_per_kernel "
+                    f"({self.rounds_per_kernel}) so chunk boundaries "
+                    "land on super-step boundaries"
+                )
+        if self.payload_wire not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                "payload_wire must be 'f32', 'bf16', or 'int8'")
+        if self.payload_wire != "f32":
+            if self.delivery not in ("routed", "pallas"):
+                raise ValueError(
+                    "payload_wire compresses the sharded push-design "
+                    "edge-share slab; it requires delivery='routed' or "
+                    "'pallas' (the scatter paths ship no slab, and the "
+                    "megakernel is single-chip)"
+                )
+            if self.routed_design != "push":
+                raise ValueError(
+                    "payload_wire compresses the push design's edge-share "
+                    "exchange; the pull design all-gathers full state "
+                    "vectors instead — drop routed_design='pull'"
+                )
+        if self.exchange_overlap:
+            if self.delivery not in ("routed", "pallas"):
+                raise ValueError(
+                    "exchange_overlap schedules the sharded push-design "
+                    "exchange on the double-buffered DMA ring; it "
+                    "requires delivery='routed' or 'pallas'"
+                )
+            if self.routed_design != "push":
+                raise ValueError(
+                    "exchange_overlap replaces the push design's "
+                    "edge-share exchange; the pull design has none — "
+                    "drop routed_design='pull'"
+                )
         if self.delivery == "invert":
             if self.algorithm != "push-sum" or self.fanout != "one":
                 raise ValueError(
@@ -468,11 +580,13 @@ class RunConfig:
                     "steps); the accelerated two-buffer schemes assume a "
                     "fixed linear iteration — run them on workload='avg'"
                 )
-            if self.delivery != "scatter":
+            if self.delivery not in ("scatter", "routed", "pallas"):
                 raise ValueError(
-                    "workload='sgp' supports delivery='scatter' (the "
-                    "routed plans' pair packing is tuned for the averaging "
-                    "payload; invert is scalar-only)"
+                    "workload='sgp' supports delivery='scatter', "
+                    "'routed', or 'pallas' (the fanout-all plans ride "
+                    "the d-dim payload through matvec_payload; invert is "
+                    "scalar-only and the megakernel fuses the scalar "
+                    "averaging round)"
                 )
         if self.accel != "off":
             if self.algorithm != "push-sum" or self.fanout != "all":
@@ -546,7 +660,8 @@ class RunConfig:
             # overhead, experiments/route_bench.py); pallas fuses those
             # passes into single gathers — budget it the same, erring
             # toward smaller chunks
-            per_edge = (12e-9 if self.delivery in ("routed", "pallas")
+            per_edge = (12e-9 if self.delivery in ("routed", "pallas",
+                                                   "megakernel")
                         else 65e-9)
             per_round_s += (num_edges or 0) * per_edge
         if jnp.dtype(self.dtype) == jnp.float64:
@@ -556,7 +671,13 @@ class RunConfig:
         # forced 4-round chunk would itself bust the watchdog — drop to
         # single-round chunks instead
         lo = 1 if per_round_s > 15.0 else 4
-        return max(lo, min(4096, int(30.0 / per_round_s)))
+        chunk = max(lo, min(4096, int(30.0 / per_round_s)))
+        if self.rounds_per_kernel > 1:
+            # chunk boundaries land on super-step boundaries (explicit
+            # chunk_rounds is validated for this; the auto pick rounds up)
+            k = self.rounds_per_kernel
+            chunk = -(-chunk // k) * k
+        return chunk
 
 
 @dataclasses.dataclass
@@ -637,6 +758,17 @@ def initial_alive(topo: Topology) -> Optional[jax.Array]:
     None = everyone healthy."""
     alive = topo.birth_alive()
     return None if alive is None else jnp.asarray(alive)
+
+
+def use_megakernel(cfg: RunConfig) -> bool:
+    """Does this config run the K-round fused kernel
+    (ops/megakernel.py)? ``--delivery megakernel`` always; the pallas
+    path joins it when ``--rounds-per-kernel`` exceeds 1. K=1 on
+    ``--delivery pallas`` stays the literal per-round program (the one
+    the goldens pin)."""
+    return cfg.delivery == "megakernel" or (
+        cfg.delivery == "pallas" and cfg.rounds_per_kernel > 1
+    )
 
 
 def build_protocol(
@@ -755,32 +887,61 @@ def build_protocol(
                     "reductions with no edges to mask — materialize the "
                     "topology or drop the loss windows"
                 )
-            # pallas rides the routed round unchanged: the delivery
-            # pytree (RoutedDelivery vs PallasDelivery) carries the
-            # kernels; the round only calls .matvec/.degree
-            round_fn = (pushsum_diffusion_round_routed
-                        if cfg.delivery in ("routed", "pallas")
-                        else pushsum_diffusion_round)
-            core = partial(
-                round_fn,
-                n=n,
-                eps=cfg.eps,
-                streak_target=cfg.streak_target,
-                predicate=cfg.predicate,
-                tol=cfg.tol,
-                all_alive=all_alive,
-                targets_alive=targets_alive,
-                clock=clock,
-            )
-            if cfg.delivery not in ("routed", "pallas"):
-                # routed runs never carry loss (RunConfig rejects it); the
-                # scatter round threads the drop windows through delivery
-                core = partial(core, loss_windows=loss_windows)
-                if cfg.edge_chunks > 1:
-                    core = partial(core, edge_chunks=cfg.edge_chunks)
+            if use_megakernel(cfg):
+                # K-round super-steps fused into one pallas_call: the
+                # kernel replays the all-alive routed round in-register,
+                # checking convergence between rounds so a super-step
+                # never runs past the supervisor predicate
+                from gossipprotocol_tpu.ops.megakernel import (
+                    make_megakernel_round,
+                )
+
+                if not all_alive:
+                    raise ValueError(
+                        "the round-loop megakernel compiles the all-alive "
+                        "round only; this run carries dead or padded rows "
+                        "(birth exclusions, a resumed dead set, or "
+                        "sharding) — use delivery='pallas' with "
+                        "rounds_per_kernel=1"
+                    )
+                core = make_megakernel_round(
+                    n=n,
+                    rounds_per_kernel=max(cfg.rounds_per_kernel, 1),
+                    eps=cfg.eps,
+                    streak_target=cfg.streak_target,
+                    predicate=cfg.predicate,
+                    tol=cfg.tol,
+                    quorum=cfg.alert_quorum,
+                    interpret=(default_platform() != "tpu"),
+                )
             else:
+                # pallas rides the routed round unchanged: the delivery
+                # pytree (RoutedDelivery vs PallasDelivery) carries the
+                # kernels; the round only calls .matvec/.degree
+                round_fn = (pushsum_diffusion_round_routed
+                            if cfg.delivery in ("routed", "pallas")
+                            else pushsum_diffusion_round)
                 core = partial(
-                    core, interpret=(default_platform() != "tpu"))
+                    round_fn,
+                    n=n,
+                    eps=cfg.eps,
+                    streak_target=cfg.streak_target,
+                    predicate=cfg.predicate,
+                    tol=cfg.tol,
+                    all_alive=all_alive,
+                    targets_alive=targets_alive,
+                    clock=clock,
+                )
+                if cfg.delivery not in ("routed", "pallas"):
+                    # routed runs never carry loss (RunConfig rejects
+                    # it); the scatter round threads the drop windows
+                    # through delivery
+                    core = partial(core, loss_windows=loss_windows)
+                    if cfg.edge_chunks > 1:
+                        core = partial(core, edge_chunks=cfg.edge_chunks)
+                else:
+                    core = partial(
+                        core, interpret=(default_platform() != "tpu"))
         elif ref:
             # the reference's actual dynamics: a single-token random walk
             # (one MainPushSum in flight, Program.fs:128; SURVEY §2.4.2).
@@ -1073,7 +1234,7 @@ def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
                         rd),
                 )
             return rd
-        if cfg.delivery == "pallas":
+        if cfg.delivery in ("pallas", "megakernel"):
             from gossipprotocol_tpu.ops.pallasdelivery import (
                 pallas_streamed_bytes_per_round,
             )
@@ -1083,10 +1244,19 @@ def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
             if tel is not None and tel.enabled:
                 tel.event(
                     "plan_cache", provenance=prov, design="single-chip",
-                    delivery="pallas",
+                    delivery=cfg.delivery,
                     streamed_bytes_per_round=pallas_streamed_bytes_per_round(
                         pd),
                 )
+            if use_megakernel(cfg):
+                # same cached gather plans, wrapped with the precomputed
+                # f32 degree; eligibility (resident gathers, foldable
+                # classes) is checked loudly here, before any compile
+                from gossipprotocol_tpu.ops.megakernel import (
+                    build_megakernel_delivery,
+                )
+
+                return build_megakernel_delivery(pd)
             return pd
         from gossipprotocol_tpu.protocols.diffusion import diffusion_edges
 
@@ -1183,12 +1353,27 @@ def mass_stats(state, all_sum=sum0) -> dict:
 
 def make_chunk_runner(round_core, done_fn, extra_stats=None,
                       counter_fn=None, counter_slots=0,
-                      trace_fn=None, trace_slots=0):
+                      trace_fn=None, trace_slots=0, *,
+                      rounds_per_step=1):
     """jitted ``(state, nbrs, base_key, round_limit) -> (state, stats)``:
     advance rounds until global convergence or ``state.round ==
     round_limit``. The supervisor predicate is evaluated in the loop
     condition — the reference's flow 3.4 folded into cond_fun — and again
     in the returned stats so the host loop needs one fetch per chunk.
+
+    ``rounds_per_step`` is the megakernel super-step width K: one body
+    call advances up to K rounds, so the counter/trace buffers carry
+    ``K - 1`` spare rows (a super-step entered at ``round_limit - 1``
+    can overshoot the chunk by that much) and each body call stamps K
+    buffer rows. The per-round counter delta is constant on the
+    megakernel's all-alive synchronous path (``sent = delivered =
+    Σ degree``), so broadcasting one delta row is exact; the trace row
+    repeats the super-step's final state — per-round residual detail
+    degrades to K-round granularity, the documented trade. The host's
+    valid-prefix slicing (``[: cur_round - chunk_start]``) drops the
+    rows a frozen-on-convergence super-step never reached. At the
+    default K=1 every expression below reduces to the literal
+    pre-megakernel program (the one the goldens pin).
 
     ``counter_fn`` (obs/counters.py contract) folds an int32
     ``[counter_slots, 3]`` message-count buffer through the scan — one
@@ -1216,6 +1401,12 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
 
         return jax.jit(chunk, donate_argnums=0)
 
+    k = rounds_per_step
+
+    def counter_rows(delta):
+        return (delta[None, :] if k == 1
+                else jnp.broadcast_to(delta[None, :], (k, 3)))
+
     if trace_fn is None:
         def chunk(state, nbrs, base_key, round_limit):
             start = state.round  # chunk entry round: buffer row 0
@@ -1225,14 +1416,15 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
                 s2 = round_core(s, nbrs, base_key)
                 delta = counter_fn(s, s2, nbrs, base_key, s.alive, None)
                 buf = jax.lax.dynamic_update_slice(
-                    buf, delta[None, :], (s.round - start, jnp.int32(0)))
+                    buf, counter_rows(delta),
+                    (s.round - start, jnp.int32(0)))
                 return s2, buf
 
             def cond(carry):
                 s, _ = carry
                 return jnp.logical_and(~done_fn(s), s.round < round_limit)
 
-            buf0 = jnp.zeros((counter_slots, 3), jnp.int32)
+            buf0 = jnp.zeros((counter_slots + k - 1, 3), jnp.int32)
             final, buf = jax.lax.while_loop(cond, body, (state, buf0))
             stats = stats_with_extra(final, done_fn, extra_stats)
             stats["counters"] = buf
@@ -1242,6 +1434,11 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
         return jax.jit(chunk, donate_argnums=0)
 
     from gossipprotocol_tpu.obs.trace import NUM_TRACE_COLS
+
+    def trace_rows(row_vec):
+        return (row_vec[None, :] if k == 1
+                else jnp.broadcast_to(row_vec[None, :],
+                                      (k, NUM_TRACE_COLS)))
 
     def chunk(state, nbrs, base_key, round_limit):
         start = state.round  # chunk entry round: buffer row 0
@@ -1254,10 +1451,11 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
             if counter_fn is not None:
                 delta = counter_fn(s, s2, nbrs, base_key, s.alive, None)
                 bufs["counters"] = jax.lax.dynamic_update_slice(
-                    bufs["counters"], delta[None, :], (row, jnp.int32(0)))
+                    bufs["counters"], counter_rows(delta),
+                    (row, jnp.int32(0)))
             bufs["trace"] = jax.lax.dynamic_update_slice(
                 bufs["trace"],
-                trace_fn(s2).astype(jnp.float32)[None, :],
+                trace_rows(trace_fn(s2).astype(jnp.float32)),
                 (row, jnp.int32(0)))
             return s2, bufs
 
@@ -1266,10 +1464,12 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
             return jnp.logical_and(~done_fn(s), s.round < round_limit)
 
         bufs0 = {
-            "trace": jnp.zeros((trace_slots, NUM_TRACE_COLS), jnp.float32),
+            "trace": jnp.zeros((trace_slots + k - 1, NUM_TRACE_COLS),
+                               jnp.float32),
         }
         if counter_fn is not None:
-            bufs0["counters"] = jnp.zeros((counter_slots, 3), jnp.int32)
+            bufs0["counters"] = jnp.zeros((counter_slots + k - 1, 3),
+                                          jnp.int32)
         final, bufs = jax.lax.while_loop(cond, body, (state, bufs0))
         stats = stats_with_extra(final, done_fn, extra_stats)
         stats["trace"] = bufs["trace"]
@@ -1670,6 +1870,18 @@ def run_simulation(
         run_topo = replay_topology(topo, cfg, start_round)
     from gossipprotocol_tpu.obs import as_telemetry
 
+    if cfg.payload_wire != "f32":
+        raise ValueError(
+            "payload_wire compresses the sharded edge-share exchange; "
+            "this single-chip run has no wire — drop the flag or run "
+            "with --shards"
+        )
+    if cfg.exchange_overlap:
+        raise ValueError(
+            "exchange_overlap rewrites the sharded exchange; this "
+            "single-chip run has no exchange — drop the flag or run "
+            "with --shards"
+        )
     tel = as_telemetry(cfg.telemetry)
     with tel.span("protocol_build", engine="single-chip"):
         state, round_core, done_fn, extra_stats, (all_alive, targets_alive) = (
@@ -1712,19 +1924,24 @@ def run_simulation(
 
     prediction = compute_prediction(run_topo, cfg, tel)
 
+    rounds_per_step = cfg.rounds_per_kernel if use_megakernel(cfg) else 1
+
     runner = make_chunk_runner(
         round_core, done_fn, extra_stats,
         counter_fn=engine_counter_fn(run_topo, all_alive, targets_alive),
         counter_slots=counter_slots,
         trace_fn=engine_trace_fn(run_topo),
         trace_slots=counter_slots,
+        rounds_per_step=rounds_per_step,
     )
 
     t0 = time.perf_counter()
     with tel.span("jit_compile", engine="single-chip"):
         compiled = runner.lower(state, nbrs, base_key, jnp.int32(0)).compile()
-    tel.record_compiled("chunk", compiled, engine="single-chip",
-                        delivery=cfg.delivery)
+    tel.record_compiled(
+        "chunk", compiled, engine="single-chip", delivery=cfg.delivery,
+        rounds_per_kernel=(rounds_per_step if use_megakernel(cfg)
+                           else None))
 
     def step(s, round_limit):
         return compiled(s, nbrs, base_key, jnp.int32(round_limit))
@@ -1751,10 +1968,14 @@ def run_simulation(
             counter_slots=counter_slots,
             trace_fn=engine_trace_fn(new_topo),
             trace_slots=counter_slots,
+            rounds_per_step=rounds_per_step,
         )
         compiled2 = runner2.lower(st, nbrs2, base_key, jnp.int32(0)).compile()
-        tel.record_compiled("chunk_rebuild", compiled2,
-                            engine="single-chip", delivery=cfg.delivery)
+        tel.record_compiled(
+            "chunk_rebuild", compiled2, engine="single-chip",
+            delivery=cfg.delivery,
+            rounds_per_kernel=(rounds_per_step if use_megakernel(cfg)
+                               else None))
 
         def step2(s, round_limit):
             return compiled2(s, nbrs2, base_key, jnp.int32(round_limit))

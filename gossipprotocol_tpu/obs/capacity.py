@@ -169,6 +169,57 @@ def _pallas_gather_bytes(e_local: int, local_rows: int,
     return pre + out + 4 * local_rows
 
 
+def _wire_bytes(cfg) -> int:
+    """Bytes per exchange-slab slot under ``payload_wire`` (the sharded
+    routed-push wire format): f32 ships raw, bf16 halves, int8 quarters
+    (the per-row f32 scale sidecar is O(num_shards), noise here)."""
+    return {"f32": 4, "bf16": 2, "int8": 1}[
+        getattr(cfg, "payload_wire", "f32")]
+
+
+def _class_pair_slots(num_edges: int, max_degree: int) -> int:
+    """Class-layout pair-slot upper bound: edges plus the BLK-row
+    quantization floor every populated small class pays (mirrors
+    ``delivery.degree_classes`` / ``build_gather_plan``)."""
+    from gossipprotocol_tpu.ops.classops import BLK
+    from gossipprotocol_tpu.ops.pallasdelivery import LANES
+
+    cp2 = 1 << max(0, (max(1, max_degree) - 1)).bit_length()
+    n_classes = cp2.bit_length()
+    if cp2 >= 512:
+        n_classes -= 2
+    return num_edges + n_classes * BLK * (LANES // 2)
+
+
+def megakernel_vmem_estimate(num_nodes: int, num_edges: int,
+                             max_degree: int) -> int:
+    """Closed-form VMEM footprint of the round-loop megakernel, the
+    analytic twin of ``ops.megakernel.megakernel_vmem_bytes`` (which
+    prices a *built* plan): state I/O cubes (5 carries in + out plus the
+    degree row, all padded to (rp, 128) f32/i32), both gather index maps
+    and their source cubes, the gathered pre/out vectors, and the
+    double-buffered per-class reduce region (bounded by the whole
+    gathered pre cube — the K-round loop reuses these same buffers, so
+    the footprint is independent of K)."""
+    from gossipprotocol_tpu.ops.pallasdelivery import (
+        LANES, TILE, TILE_ROWS,
+    )
+
+    n = int(num_nodes)
+    rp = -(-n // TILE) * TILE_ROWS
+    pairs = _class_pair_slots(num_edges, max_degree)
+    pre_slots = -(-2 * pairs // TILE) * TILE
+    out_slots = -(-2 * n // TILE) * TILE
+    pre_src = -(-(2 * n + 1) // LANES)
+    out_src = -(-2 * pairs // LANES)
+    state_io = 11 * rp * LANES * 4
+    idx = (pre_slots + out_slots) * 4
+    srcs = (pre_src + out_src) * LANES * 4
+    gathered = (pre_slots + out_slots) * 4
+    region = pre_slots * 8  # 2x-buffered largest-class region bound
+    return state_io + idx + srcs + gathered + region
+
+
 def _delivery_bytes(cfg, n_pad: int, local_rows: int, num_shards: int,
                     num_edges: int, max_degree: int,
                     implicit_full: bool) -> Tuple[int, str]:
@@ -186,20 +237,30 @@ def _delivery_bytes(cfg, n_pad: int, local_rows: int, num_shards: int,
     if is_pushsum and cfg.fanout == "all":
         if cfg.delivery == "routed":
             # routed plans: ~86 B/edge of tables per device (push design
-            # owns E/S edges; single-chip owns them all) + the f32
-            # exchange slab [num_shards, 2·block_pairs]
-            slab = 4 * num_edges if num_shards > 1 else 0
+            # owns E/S edges; single-chip owns them all) + the exchange
+            # slab [num_shards, 2·block_pairs], priced at the wire
+            # format's bytes/slot (payload_wire=bf16/int8 compresses it)
+            slab = (_wire_bytes(cfg) * num_edges if num_shards > 1
+                    else 0)
             return ROUTED_BYTES_PER_EDGE * e_local + slab, "routed"
         if cfg.delivery == "pallas":
             if num_shards > 1:
                 # sharded pallas keeps the push design's per-shard plan
                 # tables (same geometry) — only the exchange transport
                 # changes, and the remote-copy landing buffer matches
-                # the all_to_all slab byte-for-byte
-                slab = 4 * num_edges
+                # the all_to_all slab byte-for-byte (and compresses
+                # identically under payload_wire)
+                slab = _wire_bytes(cfg) * num_edges
                 return ROUTED_BYTES_PER_EDGE * e_local + slab, "pallas"
             return _pallas_gather_bytes(e_local, local_rows,
                                         max_degree), "pallas"
+        if cfg.delivery == "megakernel":
+            # single-chip only (validated upstream): same HBM-side gather
+            # tables as the resident pallas path — the K-round fusion
+            # changes VMEM pressure (see megakernel_vmem_estimate), not
+            # the argument footprint
+            return _pallas_gather_bytes(e_local, local_rows,
+                                        max_degree), "megakernel"
         # diffusion edge list: src+dst int32 per edge (+ valid byte when
         # sharded blocks carry padding) + row-aligned degree
         per_edge = 8 + (1 if num_shards > 1 else 0)
@@ -303,6 +364,21 @@ def estimate_run_bytes(
                         else min(src_rows, _PL_TILE))
         extra_per_device["pallas_vmem_scratch_bytes"] = (
             scratch_rows * _PL_LANES * 4)
+    if path == "megakernel":
+        # advisory like pallas_vmem_scratch_bytes: the whole-round fused
+        # kernel holds state + both gather cubes resident — a number
+        # past ~16 MiB predicts a Mosaic allocation failure before one
+        # happens (K does not enter: the round loop reuses the buffers)
+        extra_per_device["megakernel_vmem_bytes"] = (
+            megakernel_vmem_estimate(n, num_edges, max_degree))
+    if (num_shards > 1 and path in ("routed", "pallas")
+            and getattr(cfg, "payload_wire", "f32") != "f32"):
+        # per-device wire bytes each round under the compressed format,
+        # next to the f32 figure it replaces (manifest's
+        # exchange_bytes_per_round reports the same quantity measured)
+        extra_per_device["wire_exchange_bytes_per_round"] = (
+            _wire_bytes(cfg) * num_edges)
+        extra_per_device["f32_exchange_bytes_per_round"] = 4 * num_edges
     return {
         "kind": canonical_name(kind),
         "num_nodes": n,
@@ -445,7 +521,12 @@ def main(argv=None) -> int:
     parser.add_argument("--devices", type=int, default=1)
     parser.add_argument("--fanout", choices=["one", "all"], default="one")
     parser.add_argument("--delivery", default=None,
-                        choices=["scatter", "invert", "routed", "pallas"])
+                        choices=["scatter", "invert", "routed", "pallas",
+                                 "megakernel"])
+    parser.add_argument("--payload-wire", default="f32",
+                        choices=["f32", "bf16", "int8"],
+                        help="price the sharded exchange slab at the "
+                             "compressed wire format")
     parser.add_argument("--payload-dim", type=int, default=1)
     parser.add_argument("--workload", choices=["avg", "sgp", "gala"],
                         default="avg")
@@ -463,6 +544,10 @@ def main(argv=None) -> int:
         return int(e.code or 0)
     if args.num_nodes < 1 or args.devices < 1:
         print("plan: num_nodes and --devices must be >= 1", file=sys.stderr)
+        return 2
+    if args.delivery == "megakernel" and args.devices > 1:
+        print("plan: the round-loop megakernel is single-chip only — "
+              "drop --devices", file=sys.stderr)
         return 2
 
     import jax.numpy as jnp
@@ -484,8 +569,19 @@ def main(argv=None) -> int:
             cfg_kw.update(fanout="all", predicate="global", groups=2)
         if args.delivery is not None:
             cfg_kw["delivery"] = args.delivery
+            if args.delivery == "megakernel":
+                cfg_kw["fanout"] = "all"  # the only legal megakernel shape
         elif args.fanout == "all":
             cfg_kw["delivery"] = "routed"
+        if args.payload_wire != "f32":
+            if args.devices <= 1:
+                raise CapacityError(
+                    "--payload-wire prices the sharded exchange; it "
+                    "needs --devices N > 1")
+            cfg_kw["payload_wire"] = args.payload_wire
+            cfg_kw["fanout"] = "all"  # the wire is the routed-push slab
+            if cfg_kw.get("delivery") not in ("routed", "pallas"):
+                cfg_kw["delivery"] = "routed"
         cfg = RunConfig(**cfg_kw)
         doc = estimate_run_bytes(
             args.topology, args.num_nodes, cfg, args.devices,
@@ -537,6 +633,15 @@ def main(argv=None) -> int:
             print(f"  vmem scratch: "
                   f"{_fmt(per['pallas_vmem_scratch_bytes']):>12}/kernel"
                   "  (advisory: VMEM, not HBM)")
+        if "megakernel_vmem_bytes" in per:
+            print(f"  vmem (fused): "
+                  f"{_fmt(per['megakernel_vmem_bytes']):>12}/kernel"
+                  "  (advisory: whole round resident, K-independent)")
+        if "wire_exchange_bytes_per_round" in per:
+            print(f"  exchange:     "
+                  f"{_fmt(per['wire_exchange_bytes_per_round']):>12}"
+                  f"/round/device  ({args.payload_wire} wire; f32 would "
+                  f"be {_fmt(per['f32_exchange_bytes_per_round'])})")
         print(f"  total:        {_fmt(per['total_bytes']):>12}/device"
               f"  (argument bytes {_fmt(doc['argument_bytes'])})")
 

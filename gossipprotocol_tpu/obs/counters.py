@@ -147,10 +147,12 @@ def make_counter_fn(
         return fn
 
     if cfg.fanout == "all":
-        if cfg.delivery in ("routed", "pallas"):
-            # pallas deliveries answer the same .matvec/.degree recount
-            # surface (the exchange transport moves identical slabs, so
-            # the counts — like the trajectories — cannot differ)
+        if cfg.delivery in ("routed", "pallas", "megakernel"):
+            # pallas/megakernel deliveries answer the same .matvec/
+            # .degree recount surface (the exchange transport moves
+            # identical slabs, so the counts — like the trajectories —
+            # cannot differ; MegakernelDelivery forwards to its inner
+            # PallasDelivery)
             if axis_name is not None:
                 from gossipprotocol_tpu.ops.sharddelivery import (
                     shard_routed_message_counts,

@@ -178,8 +178,16 @@ def _render_resources(data: Dict[str, Any], manifest, out: TextIO) -> None:
                 parts.append(
                     f"out {_fmt_bytes(mem['output_size_in_bytes'])}")
             label = prog.get("label", "?")
-            tags = [t for t in (prog.get("engine"), prog.get("delivery"))
-                    if t]
+            # execution-shape tag, e.g. `chunk [2-shard, pallas, K=16,
+            # bf16]`: the shard count subsumes the "sharded" engine word
+            shards = prog.get("num_shards")
+            k = prog.get("rounds_per_kernel")
+            tags = [t for t in (
+                f"{shards}-shard" if shards else prog.get("engine"),
+                prog.get("delivery"),
+                f"K={k}" if k else None,
+                prog.get("payload_wire"),
+            ) if t]
             if tags:
                 label = f"{label} [{', '.join(tags)}]"
             out.write(f"  program {label}: "

@@ -1,0 +1,292 @@
+"""Round-loop megakernel: K protocol rounds inside one ``pallas_call``.
+
+The Pallas delivery (:mod:`gossipprotocol_tpu.ops.pallasdelivery`) fused
+the routed pipeline's copy passes into two gather kernels, but every
+protocol round still round-trips the state through HBM and pays a kernel
+launch per gather: at 1k–1M nodes, where the whole working set fits
+VMEM, launch + HBM latency dominates the round (aux_1k_ms ~250 ms on
+TPU). When BOTH gather plans run in resident mode — the sizing decision
+:func:`~gossipprotocol_tpu.ops.pallasdelivery.build_gather_plan` already
+makes — the entire round is VMEM-sized, so this module runs
+``rounds_per_kernel`` rounds in one grid-less ``pallas_call``:
+
+  gather (pre) -> class reduce -> gather (out) -> protocol update,
+
+looped ``K`` times with the state carried in registers/VMEM, touching
+HBM once per super-step instead of ~6 times per round. Exposed as
+``--delivery megakernel`` (or ``--rounds-per-kernel K`` on the pallas
+path); ``K=1`` is held bitwise-equal to ``--delivery pallas`` by
+tests/test_megakernel.py — the kernel replays the exact op sequence of
+``pushsum_diffusion_round_routed`` + ``PallasDelivery.matvec`` + the
+``classops`` fold, so under the interpreter the programs are the same
+XLA ops over the same shapes.
+
+Convergence is checked *inside* the loop: once the supervisor predicate
+holds, the remaining iterations freeze the state (``jnp.where`` on the
+done flag) and stop advancing the executed-round counter, so the final
+state and round count match what the K=1 while-loop would have produced
+— a super-step can overshoot the chunk's ``round_limit`` by at most
+``K - 1`` rounds (the chunk driver sizes its counter/trace buffers for
+that), but never runs past convergence.
+
+Eligibility is deliberately narrow — the fast path for the regime that
+needs it, loud errors everywhere else: both gathers resident (raise the
+``GOSSIP_TPU_PALLAS_RESIDENT_ROWS`` budget to widen), no degree class
+wider than one 128-lane row (hub classes with 2c > 128 need the
+accumulating big-class kernel, which has no in-register equivalent),
+plus the driver-level gates (sync clock, scalar payload, all-alive,
+single chip — RunConfig enforces).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from gossipprotocol_tpu.ops.delivery import RoutedConfigError
+from gossipprotocol_tpu.ops.pallasdelivery import (
+    LANES, TILE_ROWS, PallasDelivery,
+)
+
+
+def _pad_rows(n: int) -> int:
+    """128-lane rows of the padded 2-D state view, sublane-aligned."""
+    return -(-n // (TILE_ROWS * LANES)) * TILE_ROWS
+
+
+class MegakernelDelivery(NamedTuple):  # registered below
+    """A resident-mode :class:`PallasDelivery` plus the f32 degree
+    vector the round multiplies by (precomputed once — the same exact
+    small-integer floats ``degree.astype(f32)`` yields per round on the
+    pallas path). Exposes ``degree``/``matvec`` so the telemetry
+    recounts (obs/counters.py) take it unchanged."""
+
+    pd: PallasDelivery
+    deg_f: jax.Array              # f32 [n]
+
+    @property
+    def degree(self) -> jax.Array:
+        return self.pd.degree
+
+    def matvec(self, xs, xw, interpret: bool = False):
+        return self.pd.matvec(xs, xw, interpret)
+
+
+def _register_megakernel():
+    def flatten(m):
+        return ((m.pd, m.deg_f), None)
+
+    def unflatten(aux, children):
+        del aux
+        return MegakernelDelivery(*children)
+
+    jax.tree_util.register_pytree_node(
+        MegakernelDelivery, flatten, unflatten)
+
+
+_register_megakernel()
+
+
+def check_megakernel_eligible(pd: PallasDelivery) -> None:
+    """Raise :class:`RoutedConfigError` unless the whole round fits the
+    in-kernel loop: both gathers VMEM-resident and every degree class
+    foldable within one 128-lane row."""
+    bucketed = [name for name, g in (("gather_pre", pd.gather_pre),
+                                     ("gather_out", pd.gather_out))
+                if g.mode != "resident"]
+    if bucketed:
+        raise RoutedConfigError(
+            f"megakernel needs VMEM-resident gathers; {bucketed} "
+            "compiled in bucket mode at this size. Raise the resident "
+            "budget (GOSSIP_TPU_PALLAS_RESIDENT_ROWS, default 8192 "
+            "128-lane rows) if VMEM allows, or use --delivery pallas"
+        )
+    wide = sorted({c for c, *_ in pd.classes if 2 * c > LANES})
+    if wide:
+        raise RoutedConfigError(
+            f"megakernel folds each degree class within one {LANES}-lane "
+            f"row; hub classes {wide} span multiple rows (2c > {LANES}) "
+            "and need the accumulating big-class kernel — use "
+            "--delivery pallas"
+        )
+
+
+def build_megakernel_delivery(pd: PallasDelivery) -> MegakernelDelivery:
+    check_megakernel_eligible(pd)
+    return MegakernelDelivery(
+        pd=pd, deg_f=pd.degree.astype(jnp.float32))
+
+
+def megakernel_vmem_bytes(pd: PallasDelivery) -> int:
+    """Closed-form VMEM the K-round megakernel holds: the padded state
+    I/O (6 in + 5 out 128-lane vectors), both int32 gather index cubes,
+    the two resident gather sources with their gathered f32 streams, and
+    the widest class-reduce region with its fold accumulator.
+    K-independent — the round loop reuses the same buffers — which is
+    what makes the closed form usable for admission control
+    (obs/capacity.py mirrors it)."""
+    rp = _pad_rows(pd.n)
+    state_io = 11 * rp * LANES * 4
+    idx = (int(pd.gather_pre.idx.size) + int(pd.gather_out.idx.size)) * 4
+    srcs = (int(pd.gather_pre.src_rows)
+            + int(pd.gather_out.src_rows)) * LANES * 4
+    gathered = (int(pd.gather_pre.idx.size)
+                + int(pd.gather_out.idx.size)) * 4
+    region = max((reg_rows * LANES * 4 * 2
+                  for _c, _n_c, _start, reg_rows, _cap in pd.classes),
+                 default=0)
+    return state_io + idx + srcs + gathered + region
+
+
+def make_megakernel_round(*, n: int, rounds_per_kernel: int,
+                          eps: float, streak_target: int,
+                          predicate: str, tol: float,
+                          quorum: Optional[int] = None,
+                          interpret: bool = False):
+    """Round core ``(state, mk, base_key) -> state`` advancing up to
+    ``rounds_per_kernel`` rounds per call — the drop-in replacement for
+    the partial-applied ``pushsum_diffusion_round_routed`` in the chunk
+    runner's while-loop body (``engine/driver.py`` selects it for
+    ``--delivery megakernel`` / ``--rounds-per-kernel K``)."""
+    k = int(rounds_per_kernel)
+    rp = _pad_rows(n)
+
+    def round_core(state, mk: MegakernelDelivery, base_key):
+        del base_key  # sync clock only: fanout-all draws nothing
+        pd = mk.pd
+        pre, out = pd.gather_pre, pd.gather_out
+        classes = pd.classes
+
+        def kernel(s_ref, w_ref, ratio_ref, streak_ref, conv_ref,
+                   deg_ref, idxp_ref, idxo_ref,
+                   s_out, w_out, ratio_out, streak_out, conv_out,
+                   exec_out):
+            deg = deg_ref[...].reshape(-1)[:n]
+            inv = 1 / (deg + 1)
+            idx_pre = idxp_ref[...].reshape(-1)
+            idx_out = idxo_ref[...].reshape(-1)
+
+            def one_round(s, w, ratio, streak, conv):
+                # the literal pushsum_diffusion_round_routed all-alive
+                # path + PallasDelivery.matvec + the classops fold, op
+                # for op — what pins K=1 bitwise to --delivery pallas
+                share_s = s * inv
+                share_w = w * inv
+                flat = jnp.concatenate([share_s, share_w])
+                xp = jnp.pad(flat, (0, pre.src_rows * LANES - 2 * n))
+                f = jnp.take(xp, idx_pre, axis=None)[: pre.out_len]
+                ys = []
+                for c, n_c, start, reg_rows, _cap in classes:
+                    region = jax.lax.dynamic_slice_in_dim(
+                        f, 2 * start, reg_rows * LANES)
+                    two_c = 2 * c
+                    acc = region.reshape(-1, LANES)
+                    sh = 2
+                    while sh < two_c:
+                        acc = acc + jnp.roll(acc, -sh, axis=1)
+                        sh *= 2
+                    col = jax.lax.broadcasted_iota(
+                        jnp.int32, acc.shape, 1)
+                    fidx = ((col // 2) * two_c + (col % 2)) % LANES
+                    packed = jnp.take_along_axis(acc, fidx, axis=1)
+                    ys.append(
+                        packed[:, : LANES // c].reshape(-1)[: 2 * n_c])
+                yf = (jnp.concatenate(ys) if ys
+                      else jnp.zeros(0, jnp.float32))
+                yp = jnp.pad(yf, (0, out.src_rows * LANES - yf.shape[0]))
+                nat = jnp.take(yp, idx_out, axis=None)[: out.out_len]
+                in_s, in_w = nat[:n], nat[n:]
+                sent_s = share_s * deg
+                sent_w = share_w * deg
+                s_new = s - sent_s + in_s
+                w_new = w - sent_w + in_w
+                w_floor = jnp.maximum(
+                    w_new, jnp.asarray(1e-30, jnp.float32))
+                ratio_new = s_new / w_floor
+                if predicate == "global":
+                    mean = jnp.sum(s_new) / jnp.maximum(
+                        jnp.sum(w_new), jnp.asarray(1e-30, jnp.float32))
+                    near = jnp.abs(ratio_new - mean) <= tol
+                    streak_new = jnp.where(near, streak + 1, 0)
+                    # non-sticky, like finish_pushsum_round's global arm
+                    conv_new = (streak_new >= streak_target).astype(
+                        jnp.int32)
+                else:
+                    near = jnp.abs(ratio_new - ratio) <= eps
+                    streak_new = jnp.where(near, streak + 1, 0)
+                    conv_new = conv | (streak_new >= streak_target
+                                       ).astype(jnp.int32)
+                return s_new, w_new, ratio_new, streak_new, conv_new
+
+            def step(_, carry):
+                s, w, ratio, streak, conv, executed = carry
+                # supervisor predicate before each round, exactly where
+                # the K=1 while-loop cond evaluates it; once done, the
+                # remaining iterations freeze the carry
+                if quorum is None:
+                    done = jnp.all(conv != 0)
+                else:
+                    done = jnp.sum(conv) >= quorum
+                nxt = one_round(s, w, ratio, streak, conv)
+
+                def sel(new, old):
+                    return jnp.where(done, old, new)
+
+                return (sel(nxt[0], s), sel(nxt[1], w),
+                        sel(nxt[2], ratio), sel(nxt[3], streak),
+                        sel(nxt[4], conv),
+                        executed + jnp.where(done, 0, 1))
+
+            init = (s_ref[...].reshape(-1)[:n],
+                    w_ref[...].reshape(-1)[:n],
+                    ratio_ref[...].reshape(-1)[:n],
+                    streak_ref[...].reshape(-1)[:n],
+                    conv_ref[...].reshape(-1)[:n],
+                    jnp.int32(0))
+            s, w, ratio, streak, conv, executed = jax.lax.fori_loop(
+                0, k, step, init)
+
+            def pad2(v):
+                return jnp.pad(v, (0, rp * LANES - n)).reshape(rp, LANES)
+
+            s_out[...] = pad2(s)
+            w_out[...] = pad2(w)
+            ratio_out[...] = pad2(ratio)
+            streak_out[...] = pad2(streak)
+            conv_out[...] = pad2(conv)
+            exec_out[...] = (
+                jnp.zeros((TILE_ROWS, LANES), jnp.int32) + executed)
+
+        def pad2_in(v):
+            return jnp.pad(v, (0, rp * LANES - n)).reshape(rp, LANES)
+
+        s2, w2, ratio2, streak2, conv2, executed = pl.pallas_call(
+            kernel,
+            out_shape=[
+                jax.ShapeDtypeStruct((rp, LANES), jnp.float32),
+                jax.ShapeDtypeStruct((rp, LANES), jnp.float32),
+                jax.ShapeDtypeStruct((rp, LANES), jnp.float32),
+                jax.ShapeDtypeStruct((rp, LANES), jnp.int32),
+                jax.ShapeDtypeStruct((rp, LANES), jnp.int32),
+                jax.ShapeDtypeStruct((TILE_ROWS, LANES), jnp.int32),
+            ],
+            interpret=interpret,
+        )(pad2_in(state.s), pad2_in(state.w), pad2_in(state.ratio),
+          pad2_in(state.streak),
+          pad2_in(state.converged.astype(jnp.int32)),
+          pad2_in(mk.deg_f), pre.idx, out.idx)
+
+        def unpad(a):
+            return a.reshape(-1)[:n]
+
+        return state._replace(
+            s=unpad(s2), w=unpad(w2), ratio=unpad(ratio2),
+            streak=unpad(streak2),
+            converged=unpad(conv2).astype(bool),
+            round=state.round + executed[0, 0],
+        )
+
+    return round_core

@@ -450,8 +450,44 @@ def _exchange_kernel(me_ref, slab_ref, out_ref, send_sem, recv_sem):
         rc.wait()
 
 
+def _exchange_overlap_kernel(me_ref, slab_ref, out_ref, send_sem, recv_sem):
+    # double-buffered ring: step o pushes my block for shard (me+o) % S
+    # while step o-1's copy is still in flight, waiting on it only after
+    # the next copy has launched. Two semaphore slots suffice: step o's
+    # wait completes before step o+2 (the next user of slot o % 2) can
+    # start, and every step's copy lands in a distinct output row (row =
+    # sender id), so reuse never races data. Same permutation as the
+    # start-all-then-wait _exchange_kernel — bitwise-identical slabs —
+    # but the DMA engine always has at most two transfers queued, and
+    # the gap between wait() calls is where the overlapping local work
+    # (the per-source-shard reduce the caller scheduled) runs.
+    num_shards = slab_ref.shape[0]
+    me = me_ref[0]
+
+    def start(offset):
+        d = jax.lax.rem(me + offset, num_shards)
+        rc = pltpu.make_async_remote_copy(
+            src_ref=slab_ref.at[pl.ds(d, 1)],
+            dst_ref=out_ref.at[pl.ds(me, 1)],
+            send_sem=send_sem.at[offset % 2],
+            recv_sem=recv_sem.at[offset % 2],
+            device_id=(d,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rc.start()
+        return rc
+
+    prev = start(0)
+    for offset in range(1, num_shards):
+        cur = start(offset)
+        prev.wait()
+        prev = cur
+    prev.wait()
+
+
 def pallas_exchange(slab: jax.Array, *, axis_name: str,
-                    interpret: bool = False) -> jax.Array:
+                    interpret: bool = False,
+                    overlap: bool = False) -> jax.Array:
     """Push-design edge-share exchange as per-destination async remote
     copies: ``out[src] on shard dst = slab[dst] on shard src`` — the
     same ``[num_shards, block]`` permutation as the monolithic
@@ -463,14 +499,45 @@ def pallas_exchange(slab: jax.Array, *, axis_name: str,
     no transport, so the exchange degrades to the ``all_to_all``
     spelling — data-identical, which is what lets the 2/4/8-shard
     equality tests pin this path on CPU.
+
+    ``overlap=True`` selects the double-buffered ring schedule
+    (``--exchange-overlap``): on TPU the kernel keeps exactly two remote
+    copies in flight and waits on arrival ``o-1`` only after copy ``o``
+    has launched, so the local per-source-shard reduce overlaps the
+    remote-copy waits instead of stalling behind a start-all-then-wait
+    barrier. Off-TPU the ring decomposes into ``num_shards - 1``
+    per-offset ``ppermute`` steps — pure copies, bitwise-equal to
+    ``all_to_all``, which is what the 2/4/8-shard overlap equality
+    tests pin.
     """
     if interpret:
-        return jax.lax.all_to_all(
-            slab, axis_name, split_axis=0, concat_axis=0, tiled=True)
+        if not overlap:
+            return jax.lax.all_to_all(
+                slab, axis_name, split_axis=0, concat_axis=0, tiled=True)
+        # ring decomposition of the same permutation: at offset o every
+        # shard sends its block for shard (me + o) % S and receives, from
+        # shard (me - o) % S, that shard's block for me — landing it at
+        # out row (sender id). Copies only, so the result is bitwise the
+        # all_to_all slab while XLA is free to overlap each ppermute with
+        # the reduce work scheduled around the exchange.
+        num_shards = slab.shape[0]
+        me = jax.lax.axis_index(axis_name)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            jnp.zeros_like(slab),
+            jax.lax.dynamic_slice_in_dim(slab, me, 1, axis=0), me, axis=0)
+        for offset in range(1, num_shards):
+            perm = [(s, (s + offset) % num_shards)
+                    for s in range(num_shards)]
+            send = jax.lax.dynamic_slice_in_dim(
+                slab, (me + offset) % num_shards, 1, axis=0)
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, jax.lax.ppermute(send, axis_name, perm),
+                (me - offset) % num_shards, axis=0)
+        return out
     num_shards, block = slab.shape
     me = jax.lax.axis_index(axis_name).astype(jnp.int32).reshape(1)
     return pl.pallas_call(
-        _exchange_kernel,
+        _exchange_overlap_kernel if overlap else _exchange_kernel,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.ANY),

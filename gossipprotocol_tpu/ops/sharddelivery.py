@@ -615,16 +615,27 @@ class ShardPushDelivery(NamedTuple):  # registered below (geometry aux)
     degree: jax.Array             # int32 [local_n] (full degree)
 
     def matvec(self, xs: jax.Array, xw: jax.Array, *, axis_name: str,
-               interpret: bool = False, exchange: str = "all_to_all"):
+               interpret: bool = False, exchange: str = "all_to_all",
+               wire: str = "f32"):
         """(in_s, in_w)[local i] = sum over neighbors j of x[j], with
         ``xs``/``xw`` the LOCAL row slices (no full-state input).
 
         ``exchange``: how the cross-shard slab moves — ``"all_to_all"``
-        (the monolithic collective) or ``"pallas"`` (per-destination
+        (the monolithic collective), ``"pallas"`` (per-destination
         ``make_async_remote_copy`` DMAs,
-        :func:`~gossipprotocol_tpu.ops.pallasdelivery.pallas_exchange`).
-        Both move the identical slab, so trajectories are bitwise equal
-        either way."""
+        :func:`~gossipprotocol_tpu.ops.pallasdelivery.pallas_exchange`)
+        or ``"overlap"`` (the same DMAs on the double-buffered ring
+        schedule, ``--exchange-overlap``). All three move the identical
+        slab, so trajectories are bitwise equal across transports.
+
+        ``wire``: the on-the-wire dtype of the edge-share slab
+        (``--payload-wire``) — ``"f32"`` (bitwise default), ``"bf16"``
+        (half the exchange bytes; shares round to bf16 on the wire,
+        accumulation stays f32), or ``"int8"`` (quarter; symmetric
+        per-destination-block quantization, the [num_shards, 1] f32
+        scales ride a second tiny exchange). Lossy wires trade exchange
+        bandwidth for quantization noise in the received sums — opt-in,
+        never a default."""
         from gossipprotocol_tpu.ops import classops as co
 
         flat = jnp.concatenate([xs[: self.local_n], xw[: self.local_n]])
@@ -649,14 +660,31 @@ class ShardPushDelivery(NamedTuple):  # registered below (geometry aux)
         f_local = out[: 2 * self.m_pairs]
         slab = out[2 * self.m_pairs:].reshape(
             self.num_shards, 2 * self.block_pairs)
-        if exchange == "pallas":
-            from gossipprotocol_tpu.ops.pallasdelivery import pallas_exchange
+        def ship(block):
+            if exchange in ("pallas", "overlap"):
+                from gossipprotocol_tpu.ops.pallasdelivery import (
+                    pallas_exchange,
+                )
 
-            incoming = pallas_exchange(slab, axis_name=axis_name,
-                                       interpret=interpret)
+                return pallas_exchange(block, axis_name=axis_name,
+                                       interpret=interpret,
+                                       overlap=(exchange == "overlap"))
+            return jax.lax.all_to_all(
+                block, axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+        if wire == "bf16":
+            incoming = ship(slab.astype(jnp.bfloat16)).astype(jnp.float32)
+        elif wire == "int8":
+            # symmetric per-destination-block quantization: each of my S
+            # outgoing blocks gets its own scale (amax/127), shipped as a
+            # [S, 1] f32 sidecar through the same permutation, so every
+            # receiver dequantizes block s with the scale shard s used
+            amax = jnp.max(jnp.abs(slab), axis=1, keepdims=True)
+            scale = jnp.maximum(amax, 1e-30) / 127.0
+            q = jnp.round(slab / scale).astype(jnp.int8)
+            incoming = ship(q).astype(jnp.float32) * ship(scale)
         else:
-            incoming = jax.lax.all_to_all(
-                slab, axis_name, split_axis=0, concat_axis=0, tiled=True)
+            incoming = ship(slab)
         # every real f slot reads from exactly one source: its own
         # f_local slot (intra-shard) or its incoming block slot (cross)
         f = _apply_chain(self.plan_recv,
@@ -1192,14 +1220,19 @@ def pushsum_diffusion_round_routed_push(
     all_sum,
     axis_name: str,
     exchange: str = "all_to_all",
+    wire: str = "f32",
     clock: tuple = (),
 ):
     """Sharded fanout-all round, PUSH design: expand owned rows, one
     edge-share exchange of cross-shard shares (2·E/S·4 B per shard — no
     full-state ``all_gather`` anywhere in the round), reduce locally.
-    ``exchange`` picks the transport (``"all_to_all"`` collective, or
-    ``"pallas"`` per-destination async remote copies — bitwise-equal
-    slabs, see :meth:`ShardPushDelivery.matvec`).
+    ``exchange`` picks the transport (``"all_to_all"`` collective,
+    ``"pallas"`` per-destination async remote copies, or ``"overlap"``
+    double-buffered ring — bitwise-equal slabs, see
+    :meth:`ShardPushDelivery.matvec`); ``wire`` the opt-in slab
+    compression (``--payload-wire``), applied to the payload exchange
+    only — the live-degree pass below ships exact small-integer floats
+    the round multiplies sent-counts by, so it always stays f32.
     Mathematics and legality identical to the single-chip
     :func:`~gossipprotocol_tpu.protocols.diffusion.
     pushsum_diffusion_round_routed`; the trajectory is bitwise equal to
@@ -1235,7 +1268,8 @@ def pushsum_diffusion_round_routed_push(
         )
     in_s, in_w = matvec_payload(
         lambda a, b: rd.matvec(a, b, axis_name=axis_name,
-                               interpret=interpret, exchange=exchange),
+                               interpret=interpret, exchange=exchange,
+                               wire=wire),
         share_s, share_w,
     )
     if all_alive or targets_alive:
@@ -1332,6 +1366,21 @@ def push_exchange_bytes_per_round(sd: ShardPushDelivery) -> int:
     fast paths (two while a fault plan forces the live-degree pass) —
     the telemetry manifest records this static figure."""
     return int(sd.num_shards) * 2 * int(sd.block_pairs) * 4
+
+
+def push_exchange_wire_bytes_per_round(sd: ShardPushDelivery,
+                                       wire: str = "f32") -> int:
+    """Exchange bytes under the ``--payload-wire`` compression: the slab
+    in its wire dtype, plus the int8 mode's [num_shards, 1] f32 scale
+    sidecar. ``wire='f32'`` reproduces
+    :func:`push_exchange_bytes_per_round` exactly, so default-path
+    manifests are unchanged."""
+    slots = int(sd.num_shards) * 2 * int(sd.block_pairs)
+    if wire == "bf16":
+        return slots * 2
+    if wire == "int8":
+        return slots + int(sd.num_shards) * 4
+    return slots * 4
 
 
 def pull_exchange_bytes_per_round(sd: ShardRoutedDelivery) -> int:

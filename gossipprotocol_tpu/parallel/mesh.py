@@ -53,15 +53,21 @@ def make_mesh(
     num_devices: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """1-D mesh over ``num_devices`` (default: all visible) devices."""
+    """1-D mesh over ``num_devices`` (default: all visible) devices.
+
+    ``num_devices`` bounds the mesh even when an explicit ``devices``
+    pool is given — callers like ``run_simulation_sharded(num_devices=2,
+    backend="cpu")`` hand over the backend's full device list and expect
+    the count to pick the mesh size, not be silently ignored.
+    """
     if devices is None:
         devices = jax.devices()
-        if num_devices is not None:
-            if num_devices > len(devices):
-                raise ValueError(
-                    f"requested {num_devices} devices, only {len(devices)} visible"
-                )
-            devices = devices[:num_devices]
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} visible"
+            )
+        devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (NODES_AXIS,))
 
 

@@ -202,10 +202,18 @@ def _sharded_core(
             if push:
                 # delivery='pallas' swaps the push exchange transport to
                 # per-destination async remote copies (pallasdelivery.
-                # pallas_exchange) — RunConfig rejects pallas+pull
-                kw["exchange"] = ("pallas" if cfg.delivery == "pallas"
-                                  else "all_to_all")
-            return partial(
+                # pallas_exchange) — RunConfig rejects pallas+pull.
+                # exchange_overlap upgrades that to the double-buffered
+                # DMA ring (bitwise-equal payload bytes, overlapped
+                # waits); payload_wire quantizes the edge-share slab on
+                # the wire (bf16/int8) with f32 accumulation.
+                if cfg.exchange_overlap:
+                    kw["exchange"] = "overlap"
+                else:
+                    kw["exchange"] = ("pallas" if cfg.delivery == "pallas"
+                                      else "all_to_all")
+                kw["wire"] = cfg.payload_wire
+            return wrap_workload(partial(
                 pushsum_diffusion_round_routed_push
                 if push
                 else pushsum_diffusion_round_routed_sharded,
@@ -221,7 +229,7 @@ def _sharded_core(
                 axis_name=NODES_AXIS,
                 clock=clock,
                 **kw,
-            )
+            ))
         return wrap_workload(partial(
             pushsum_diffusion_round_core,
             n=n,
@@ -424,8 +432,13 @@ def make_sharded_chunk_runner(
 
         if routed:
             # the stacked shard-delivery leaves arrive as this device's
-            # [1, ...] slice; the round core drops the axis itself
-            round_fn = partial(core, shard_rd=nbrs, base_key=base_key)
+            # [1, ...] slice; the round core drops the axis itself. The
+            # SGP/GALA wrapper rides the bundle in its generic nbrs slot
+            # and forwards bundle.nbrs to the mix core positionally
+            if cfg.workload in ("sgp", "gala"):
+                round_fn = partial(core, nbrs=nbrs, base_key=base_key)
+            else:
+                round_fn = partial(core, shard_rd=nbrs, base_key=base_key)
         elif is_pushsum and cfg.fanout == "all":
             # diffusion: no draws, no gids — edges are pre-localized by
             # source block, delivery is the same scatter2 collective.
@@ -652,7 +665,8 @@ def make_sharded_chunk_runner(
                 nbrs, prov = plancache.shard_push_deliveries_cached(
                     topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
                     build_workers=cfg.build_workers)
-                exch = sharddelivery.push_exchange_bytes_per_round(nbrs)
+                exch = sharddelivery.push_exchange_wire_bytes_per_round(
+                    nbrs, cfg.payload_wire)
             else:
                 nbrs, prov = plancache.shard_deliveries_cached(
                     topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
@@ -775,6 +789,14 @@ def run_simulation_sharded(
     ``initial_state`` resumes from a (trimmed) checkpoint: it is re-padded
     to the mesh and takes over from its recorded round.
     """
+    from gossipprotocol_tpu.engine.driver import use_megakernel
+
+    if use_megakernel(cfg):
+        raise ValueError(
+            "the round-loop megakernel is single-chip only (the in-kernel "
+            "round has no exchange step) — drop --shards, or use "
+            "--delivery pallas with rounds_per_kernel=1"
+        )
     if mesh is None:
         devices = jax.devices(backend) if backend else None
         mesh = make_mesh(num_devices, devices=devices)
@@ -815,7 +837,8 @@ def run_simulation_sharded(
             plans_host, prov = plancache.shard_push_deliveries_cached(
                 run_topo, n_padded, num_shards, cache_dir=cfg.plan_cache,
                 build_workers=cfg.build_workers)
-        exch = sharddelivery.push_exchange_bytes_per_round(plans_host)
+        exch = sharddelivery.push_exchange_wire_bytes_per_round(
+            plans_host, cfg.payload_wire)
         tel.event(
             "plan_cache", provenance=prov, design="push",
             num_shards=num_shards, exchange_bytes_per_round=exch,
@@ -842,8 +865,11 @@ def run_simulation_sharded(
     t0 = time.perf_counter()
     with tel.span("jit_compile", engine="sharded"):
         compiled = runner.lower(state, nbrs, seed, jnp.int32(0)).compile()
-    tel.record_compiled("chunk", compiled, engine="sharded",
-                        num_shards=num_shards, delivery=cfg.delivery)
+    tel.record_compiled(
+        "chunk", compiled, engine="sharded", num_shards=num_shards,
+        delivery=cfg.delivery,
+        payload_wire=(cfg.payload_wire if cfg.payload_wire != "f32"
+                      else None))
 
     def step(s, round_limit):
         return compiled(s, nbrs, seed, jnp.int32(round_limit))
@@ -897,8 +923,11 @@ def run_simulation_sharded(
             nbrs_override=nbrs_over, counter_slots=counter_slots,
         )
         compiled2 = runner2.lower(st, nbrs2, seed, jnp.int32(0)).compile()
-        tel.record_compiled("chunk_rebuild", compiled2, engine="sharded",
-                            num_shards=num_shards, delivery=cfg.delivery)
+        tel.record_compiled(
+            "chunk_rebuild", compiled2, engine="sharded",
+            num_shards=num_shards, delivery=cfg.delivery,
+            payload_wire=(cfg.payload_wire if cfg.payload_wire != "f32"
+                          else None))
 
         def step2(s, round_limit):
             return compiled2(s, nbrs2, seed, jnp.int32(round_limit))

@@ -73,6 +73,14 @@ TRAJECTORY_FIELDS = (
     # bitwise only against the same plan. Stored as a content digest —
     # explicit edge lists can be large (trajectory_meta normalizes it)
     "event_plan",
+    # kernel/wire execution shape: rounds_per_kernel changes the chunk
+    # super-step granularity (trace rows, counter folding, round-limit
+    # overshoot inside a super-step) and payload_wire changes the
+    # sharded exchange's float values — resuming under a different K or
+    # wire format splices trajectories and is refused. exchange_overlap
+    # is deliberately NOT here: it moves identical bytes in an identical
+    # order, bitwise-equal to the start-all-then-wait transport.
+    "rounds_per_kernel", "payload_wire",
 )
 
 
@@ -101,7 +109,11 @@ LEGACY_FIELD_DEFAULTS = {"fanout": "one", "delivery": "scatter",
                          "groups": 1,
                          # pre-events checkpoints ran on a static (or
                          # repair-only) adjacency: no event plan
-                         "event_plan": "none"}
+                         "event_plan": "none",
+                         # pre-megakernel checkpoints ran one round per
+                         # kernel on the uncompressed f32 wire — the only
+                         # behavior that existed
+                         "rounds_per_kernel": 1, "payload_wire": "f32"}
 
 # Sentinel written for alert_quorum=None (the all-nodes stop rule). None
 # cannot be stored raw: resume validation could not tell "all-nodes run"

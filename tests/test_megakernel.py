@@ -1,0 +1,245 @@
+"""Round-loop megakernel (ops/megakernel.py, ISSUE 14): K protocol
+rounds fused into one VMEM-resident pallas_call.
+
+The equivalence bar is BITWISE against ``--delivery pallas`` for every
+K — not just K=1: the in-kernel loop checks the supervisor predicate
+before each round exactly where the K=1 while-loop cond does, and once
+it fires the remaining iterations freeze the carry, so the final state
+AND the round count match the un-fused trajectory. Eligibility is
+loudly narrow (resident gathers, no hub classes, all-alive sync
+single-chip) — a config it cannot run bitwise must be an error, never
+a silent approximation."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.obs import Telemetry
+from gossipprotocol_tpu.obs.capacity import (
+    estimate_for_topology,
+    megakernel_vmem_estimate,
+)
+from gossipprotocol_tpu.ops.delivery import RoutedConfigError
+from gossipprotocol_tpu.ops.megakernel import (
+    build_megakernel_delivery,
+    megakernel_vmem_bytes,
+)
+from gossipprotocol_tpu.ops.pallasdelivery import build_pallas_delivery
+from gossipprotocol_tpu.parallel import run_simulation_sharded
+
+# fixed round budget (early stop disabled): trajectory comparison, same
+# bar as test_pallasdelivery.py
+_BASE = dict(algorithm="push-sum", fanout="all", predicate="global",
+             tol=1e-4, seed=11, chunk_rounds=16, max_rounds=48,
+             streak_target=2**30)
+
+
+def _assert_bitwise(r1, r2):
+    np.testing.assert_array_equal(np.asarray(r1.final_state.s),
+                                  np.asarray(r2.final_state.s))
+    np.testing.assert_array_equal(np.asarray(r1.final_state.w),
+                                  np.asarray(r2.final_state.w))
+
+
+_run_cache: dict = {}
+
+
+def _cached_run(kind, **kw):
+    key = (kind, tuple(sorted(kw.items())))
+    if key not in _run_cache:
+        topo = (build_topology("line", 130) if kind == "line"
+                else build_topology("imp3D", 216, seed=4))
+        _run_cache[key] = (topo, run_simulation(topo, RunConfig(**kw)))
+    return _run_cache[key]
+
+
+# ----------------------------------------------- fixed-budget, bitwise
+
+
+@pytest.mark.parametrize("kind", ["line", "imp3D"])
+@pytest.mark.parametrize("k", [1, 4, 16])
+def test_megakernel_bitwise_matches_pallas(kind, k):
+    topo, r_pl = _cached_run(kind, **dict(_BASE, delivery="pallas"))
+    r_mk = run_simulation(topo, RunConfig(
+        **dict(_BASE, delivery="megakernel", rounds_per_kernel=k)))
+    assert r_pl.rounds == r_mk.rounds == _BASE["max_rounds"]
+    _assert_bitwise(r_pl, r_mk)
+
+
+def test_rounds_per_kernel_on_pallas_path_is_the_same_engine():
+    """``--delivery pallas --rounds-per-kernel K`` selects the identical
+    fused program — the two spellings may not diverge."""
+    topo, r_mk = _cached_run(
+        "imp3D", **dict(_BASE, delivery="megakernel", rounds_per_kernel=4))
+    r_pk = run_simulation(topo, RunConfig(
+        **dict(_BASE, delivery="pallas", rounds_per_kernel=4)))
+    assert r_mk.rounds == r_pk.rounds
+    _assert_bitwise(r_mk, r_pk)
+
+
+# ------------------------------------------ convergence / freeze rules
+
+
+@pytest.mark.parametrize("k", [4, 16])
+def test_megakernel_freezes_at_convergence(k):
+    """Convergence mid-super-step: the in-kernel freeze must reproduce
+    the K=1 round count exactly, not overshoot to the super-step edge."""
+    conv = dict(_BASE, predicate="delta", eps=1e-6, streak_target=2,
+                max_rounds=4096, chunk_rounds=16)
+    topo, r_pl = _cached_run("imp3D", **dict(conv, delivery="pallas"))
+    r_mk = run_simulation(topo, RunConfig(
+        **dict(conv, delivery="megakernel", rounds_per_kernel=k)))
+    assert r_pl.converged and r_mk.converged
+    assert r_pl.rounds == r_mk.rounds
+    _assert_bitwise(r_pl, r_mk)
+
+
+def test_megakernel_counters_match_pallas(tmp_path):
+    """The chunk driver folds the per-super-step counter delta back to
+    per-round rows; totals must equal the K=1 accounting."""
+    totals = {}
+    for name, kw in (("pallas", dict(delivery="pallas")),
+                     ("mk", dict(delivery="megakernel",
+                                 rounds_per_kernel=4))):
+        tel = Telemetry(str(tmp_path / name), counters=True)
+        topo = build_topology("imp3D", 216, seed=4)
+        run_simulation(topo, RunConfig(
+            **dict(_BASE, telemetry=tel, **kw)))
+        tel.close()
+        totals[name] = dict(tel.totals)
+    assert totals["mk"] == totals["pallas"]
+
+
+# ----------------------------------------------------- loud rejections
+
+
+def test_megakernel_rejects_hub_classes():
+    """power_law grows a 512-wide degree class — the in-register fold
+    cannot span rows, so the build must refuse, not approximate."""
+    topo = build_topology("powerlaw", 400, seed=3, m=3)
+    pd = build_pallas_delivery(topo, device=False)
+    with pytest.raises(RoutedConfigError, match="hub classes"):
+        build_megakernel_delivery(pd)
+
+
+def test_megakernel_rejects_bucket_mode_gathers():
+    topo = build_topology("imp3D", 216, seed=4)
+    pd = build_pallas_delivery(topo, device=False, resident_rows=1)
+    with pytest.raises(RoutedConfigError, match="resident"):
+        build_megakernel_delivery(pd)
+
+
+def test_megakernel_config_gates():
+    base = dict(algorithm="push-sum", fanout="all", predicate="global")
+    with pytest.raises(ValueError, match="rounds_per_kernel"):
+        RunConfig(delivery="scatter", rounds_per_kernel=4, **base)
+    with pytest.raises(ValueError, match="multiple"):
+        RunConfig(delivery="megakernel", rounds_per_kernel=4,
+                  chunk_rounds=6, **base)
+    with pytest.raises(ValueError, match="clock"):
+        RunConfig(delivery="megakernel", clock="poisson",
+                  activation_rate=0.5, **base)
+    # the fused round is the scalar averaging protocol
+    with pytest.raises(ValueError):
+        RunConfig(delivery="megakernel", payload_dim=4, **base)
+
+
+def test_megakernel_is_single_chip_only(cpu_devices):
+    topo = build_topology("imp3D", 216, seed=4)
+    with pytest.raises(ValueError, match="single-chip"):
+        run_simulation_sharded(
+            topo, RunConfig(**dict(_BASE, delivery="megakernel")),
+            num_devices=2, backend="cpu")
+
+
+def test_payload_wire_rejected_single_chip():
+    topo = build_topology("imp3D", 216, seed=4)
+    with pytest.raises(ValueError, match="wire"):
+        run_simulation(topo, RunConfig(
+            **dict(_BASE, delivery="routed", payload_wire="bf16")))
+
+
+# ------------------------------------------------------ capacity model
+
+
+def test_capacity_megakernel_tracks_memory_analysis(tmp_path):
+    """delivery='megakernel' argument bytes track memory_analysis()
+    like the pallas path, and the closed-form VMEM estimate is a true
+    (bounded) upper bound on the built plan's exact footprint."""
+    tel = Telemetry(str(tmp_path / "tel"))
+    topo = build_topology("line", 512, seed=0)
+    cfg = RunConfig(algorithm="push-sum", fanout="all", predicate="global",
+                    delivery="megakernel", rounds_per_kernel=4,
+                    seed=0, max_rounds=40, chunk_rounds=40,
+                    streak_target=2**30, telemetry=tel)
+    run_simulation(topo, cfg)
+    tel.close()
+    from gossipprotocol_tpu.obs.resources import load_resources
+
+    doc = load_resources(str(tmp_path / "tel"))
+    chunk = next(p for p in doc["programs"] if p["label"] == "chunk")
+    assert chunk.get("delivery") == "megakernel"
+    assert chunk.get("rounds_per_kernel") == 4
+    actual = chunk["memory"].get("argument_size_in_bytes")
+    est = estimate_for_topology(topo, cfg, 1)
+    assert est["delivery_path"] == "megakernel"
+    if actual:
+        rel = abs(est["argument_bytes"] - actual) / actual
+        assert rel <= 0.35, (
+            f"estimate {est['argument_bytes']} vs measured {actual} "
+            f"({rel:.0%} > 35%) — {est}"
+        )
+    assert "megakernel_vmem_bytes" in est["per_device"]
+
+    pd = build_pallas_delivery(topo, device=False)
+    exact = megakernel_vmem_bytes(pd)
+    closed = megakernel_vmem_estimate(
+        topo.num_nodes, int(topo.num_directed_edges),
+        int(topo.degree.max()))
+    assert exact <= closed <= 4 * exact
+
+
+# ------------------------------------------------------ resume refusal
+
+
+def test_resume_refuses_mismatched_kernel_and_wire():
+    from gossipprotocol_tpu.utils.checkpoint import (
+        field_matches,
+        trajectory_meta,
+    )
+
+    cfg = RunConfig(**dict(_BASE, delivery="megakernel",
+                           rounds_per_kernel=4))
+    meta = trajectory_meta(cfg)
+    assert field_matches(meta, "rounds_per_kernel", 4)
+    assert not field_matches(meta, "rounds_per_kernel", 1)
+    assert field_matches(meta, "payload_wire", "f32")
+    assert not field_matches(meta, "payload_wire", "bf16")
+    # pre-upgrade checkpoints pin the only behavior that existed
+    assert not field_matches({}, "rounds_per_kernel", 4)
+    assert field_matches({}, "rounds_per_kernel", 1)
+    assert not field_matches({}, "payload_wire", "int8")
+    assert field_matches({}, "payload_wire", "f32")
+
+
+# ------------------------------------------------------- report tags
+
+
+def test_report_renders_kernel_tag(tmp_path, capsys):
+    """The chunk program tag carries K (and the wire column sharded):
+    `chunk [single-chip, megakernel, K=4]`."""
+    tel = Telemetry(str(tmp_path / "tel"))
+    topo = build_topology("imp3D", 216, seed=4)
+    run_simulation(topo, RunConfig(
+        **dict(_BASE, delivery="megakernel", rounds_per_kernel=4,
+               telemetry=tel)))
+    tel.close()
+    from gossipprotocol_tpu.obs.report import main as report_main
+
+    rc = report_main([str(tmp_path / "tel")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "megakernel" in out
+    assert "K=4" in out

@@ -125,6 +125,124 @@ def test_sharded_pallas_bitwise_matches_single_chip(cpu_devices,
     _assert_bitwise(r1, rs)
 
 
+# ------------------------------------------- overlap exchange, bitwise
+
+
+@pytest.mark.parametrize("num_devices", [2, 4, 8])
+@pytest.mark.parametrize("delivery", ["routed", "pallas"])
+def test_overlap_exchange_bitwise(cpu_devices, num_devices, delivery):
+    """The double-buffered DMA ring (CPU interpret: the equivalent
+    ppermute ring) moves the same slab rows to the same destinations as
+    start-all-then-wait — bitwise across shard counts."""
+    topo, r1 = _routed_run("imp3D")
+    rs = run_simulation_sharded(
+        topo, RunConfig(**_BASE, delivery=delivery, exchange_overlap=True),
+        num_devices=num_devices, backend="cpu")
+    assert r1.rounds == rs.rounds == 24
+    _assert_bitwise(r1, rs)
+
+
+def test_overlap_requires_push_design():
+    with pytest.raises(ValueError, match="pull"):
+        RunConfig(**_BASE, delivery="routed", routed_design="pull",
+                  exchange_overlap=True)
+
+
+# ----------------------------------------------- compressed wire payloads
+
+
+def test_wire_bytes_accounting():
+    """f32 wire reproduces the pre-wire byte figure exactly; bf16
+    halves it; int8 quarters it plus the per-destination-row f32 scale
+    sidecar."""
+    from gossipprotocol_tpu.ops import sharddelivery as sd
+
+    topo = _TOPOLOGIES["imp3D"]()
+    from gossipprotocol_tpu.ops.plancache import shard_push_deliveries_cached
+    from gossipprotocol_tpu.parallel.mesh import padded_size
+
+    nbrs, _ = shard_push_deliveries_cached(
+        topo, padded_size(topo.num_nodes, 2), 2, cache_dir=None)
+    f32 = sd.push_exchange_bytes_per_round(nbrs)
+    assert sd.push_exchange_wire_bytes_per_round(nbrs, "f32") == f32
+    assert sd.push_exchange_wire_bytes_per_round(nbrs, "bf16") == f32 // 2
+    assert sd.push_exchange_wire_bytes_per_round(nbrs, "int8") \
+        == f32 // 4 + 2 * 4
+
+
+# quantization noise floors the ratio-consensus predicate, so the wire
+# grid compares fixed 64-round budgets (early stop disabled) instead of
+# waiting on convergence — same device budget every wire, ~3% loss gap
+_SGP_WIRE_BASE = dict(algorithm="push-sum", fanout="all", workload="sgp",
+                      predicate="global", payload_dim=4, seed=7, tol=1e-3,
+                      chunk_rounds=16, max_rounds=64, streak_target=2**30,
+                      delivery="routed")
+_sgp_f32_loss: list = []
+
+
+@pytest.mark.parametrize("wire", ["bf16", "int8"])
+def test_sgp_trains_under_compressed_wire(cpu_devices, wire, tmp_path):
+    """SGP over the quantized wire optimizes to the same loss scale as
+    the f32 trajectory at an identical round budget, and the manifest
+    records the halved/quartered exchange bytes."""
+    import json
+    import os
+
+    topo = build_topology("imp3D", 64, seed=1)
+    if not _sgp_f32_loss:
+        r32 = run_simulation_sharded(topo, RunConfig(**_SGP_WIRE_BASE),
+                                     num_devices=2, backend="cpu")
+        _sgp_f32_loss.append(float(np.asarray(r32.final_state.loss)))
+    loss32 = _sgp_f32_loss[0]
+    tel = Telemetry(str(tmp_path / wire), counters=False)
+    rq = run_simulation_sharded(
+        topo, RunConfig(**_SGP_WIRE_BASE, payload_wire=wire,
+                        telemetry=tel),
+        num_devices=2, backend="cpu")
+    tel.close()
+    lossq = float(np.asarray(rq.final_state.loss))
+    assert rq.rounds == _SGP_WIRE_BASE["max_rounds"]
+    # the optimizer actually descended (test_learn.py bar), and the
+    # wire noise did not change the loss scale
+    assert lossq < 0.5
+    assert lossq <= 1.25 * loss32 + 1e-6
+
+    exch = None
+    with open(os.path.join(str(tmp_path / wire), "events.jsonl")) as fh:
+        for line in fh:
+            e = json.loads(line)
+            if e.get("name") == "plan_cache":
+                exch = e["attrs"]["exchange_bytes_per_round"]
+    from gossipprotocol_tpu.ops import sharddelivery as sd
+    from gossipprotocol_tpu.ops.plancache import shard_push_deliveries_cached
+    from gossipprotocol_tpu.parallel.mesh import padded_size
+
+    nbrs, _ = shard_push_deliveries_cached(
+        topo, padded_size(topo.num_nodes, 2), 2, cache_dir=None)
+    assert exch == sd.push_exchange_wire_bytes_per_round(nbrs, wire)
+    assert exch < sd.push_exchange_bytes_per_round(nbrs)
+
+    # the quantized wire rounds mass on the exchange by design — the
+    # drift rule must gate on the recorded wire, not flag a healthy run
+    # (and keep firing for f32, where drift means a real defect)
+    from gossipprotocol_tpu.obs.anomaly import anomaly_flags
+
+    manifest = {"config": {"payload_wire": wire},
+                "max_mass_drift_ulps": 3e4,
+                "result": {"converged": True}}
+    assert not [f for f in anomaly_flags(manifest, []) if "drift" in f]
+    manifest["config"]["payload_wire"] = "f32"
+    assert [f for f in anomaly_flags(manifest, []) if "drift" in f]
+
+
+def test_wire_requires_sharded_push():
+    with pytest.raises(ValueError, match="pull"):
+        RunConfig(**_BASE, delivery="routed", routed_design="pull",
+                  payload_wire="bf16")
+    with pytest.raises(ValueError, match="payload_wire"):
+        RunConfig(**dict(_BASE, delivery="scatter", payload_wire="bf16"))
+
+
 # ------------------------------------------------------------ plan cache
 
 
