@@ -246,11 +246,91 @@ def _delivery_microbench() -> None:
     }))
 
 
+def _sweep_microbench() -> None:
+    """``BENCH_SWEEP_LANES=B``: batched-sweep throughput vs serial runs.
+
+    Runs one B-lane seed sweep (push-sum, imp3D — one plan build, one
+    compile, B trajectories under vmap) and then the same B configs as
+    standalone serial runs, and prints ONE JSON line: sustained
+    ``runs_per_sec`` through the batched path, the single compile
+    amortized per lane (``compile_s_per_lane``), and the
+    ``sweep_vs_serial`` wall ratio (>1 = the sweep beats B serial runs).
+    The lane-vs-standalone bitwise oracle runs first — a wrong-fast
+    sweep must not produce a datapoint.
+
+    Knobs: ``BENCH_SWEEP_LANES`` (lane count), ``BENCH_SWEEP_NODES``
+    (default 4096), ``BENCH_SWEEP_MAX_ROUNDS`` (default 4096).
+    """
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+
+    from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+    from gossipprotocol_tpu.sweep import SweepSpec
+
+    lanes = int(os.environ.get("BENCH_SWEEP_LANES", 8))
+    n = int(os.environ.get("BENCH_SWEEP_NODES", 4096))
+    max_rounds = int(os.environ.get("BENCH_SWEEP_MAX_ROUNDS", 4096))
+    topo = build_topology("imp3D", n, seed=0)
+    base = RunConfig(algorithm="push-sum", seed=0, max_rounds=max_rounds)
+
+    res = run_simulation(
+        topo, dataclasses.replace(base, sweep=SweepSpec.from_seeds(lanes)))
+    assert res.converged, (
+        f"sweep did not converge: {sum(1 for r in res.lane_records if r['converged'])}"
+        f"/{lanes} lanes at round {res.rounds}")
+
+    serial_wall_ms = 0.0
+    serial_compile_ms = 0.0
+    bitwise = True
+    for i in range(lanes):
+        solo = run_simulation(topo, dataclasses.replace(base, seed=i))
+        serial_wall_ms += solo.wall_ms
+        serial_compile_ms += solo.compile_ms
+        lane = res.lane_state(i)
+        bitwise = bitwise and solo.rounds == res.lane_records[i]["rounds"] and all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree_util.tree_leaves(lane),
+                            jax.tree_util.tree_leaves(solo.final_state)))
+    # correctness oracle before any speedup claim
+    assert bitwise, "sweep lanes are not bitwise equal to standalone runs"
+
+    print(json.dumps({
+        "metric": "sweep_lanes_pushsum_imp3d",
+        "nodes": topo.num_nodes,
+        "lanes": lanes,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "bitwise_equal": True,
+        "value": round(lanes / (res.wall_ms / 1e3), 2),
+        "unit": "runs/s",
+        "sweep_wall_s": round(res.wall_ms / 1e3, 4),
+        "sweep_compile_s": round(res.compile_ms / 1e3, 3),
+        "compile_s_per_lane": round(res.compile_ms / 1e3 / lanes, 4),
+        "serial_wall_s": round(serial_wall_ms / 1e3, 4),
+        "serial_compile_s": round(serial_compile_ms / 1e3, 3),
+        # end-to-end ratio: B serial runs each pay their own compile,
+        # the sweep pays one — this is the number "run B configs" sees
+        "sweep_vs_serial": round(
+            (serial_wall_ms + serial_compile_ms)
+            / (res.wall_ms + res.compile_ms), 2),
+        "sweep_vs_serial_runtime": round(serial_wall_ms / res.wall_ms, 2),
+        "rounds_max": res.rounds,
+        "peak_rss_bytes": _peak_rss(),
+    }))
+
+
 def main():
     probe_attempts = _probe_backend()
 
     if os.environ.get("BENCH_DELIVERY_ONLY", "0") == "1":
         _delivery_microbench()
+        return
+
+    if os.environ.get("BENCH_SWEEP_LANES", "0") != "0":
+        _sweep_microbench()
         return
 
     import jax

@@ -23,6 +23,12 @@ A clock spec is a static hashable tuple so it can ride jit
   activation coins are keyed on ``gid // id_div``. ``id_div = 1`` gives
   independent per-node clocks; the GALA workload passes the learner
   group size so a whole group shares one clock and gossips as a unit.
+* ``("prob", p, id_div)`` — Poisson clock with the per-round activation
+  probability supplied directly as ``p``, which may be a *traced* f32
+  scalar. The sweep engine uses this to thread per-lane activation
+  rates through one vmapped program; ``p`` must be the host-rounded
+  ``float32(1 - exp(-rate))`` so lanes stay bitwise equal to the
+  static-rate program.
 """
 
 from __future__ import annotations
@@ -80,11 +86,18 @@ def activation_mask(round_key: jax.Array, clock: Tuple,
     any of this (the goldens pin the pre-async program text).
     """
     assert clock, "activation_mask called under the synchronous clock"
-    p = activation_probability(clock)
-    id_div = int(clock[1])
+    if clock[0] == "prob":
+        # traced-probability spec (sweep lanes): p is already the
+        # host-rounded float32 activation probability — use it verbatim
+        # so the draw threshold matches the static-rate program bitwise
+        p_arr = jnp.asarray(clock[1], jnp.float32)
+        id_div = int(clock[2])
+    else:
+        p_arr = jnp.float32(activation_probability(clock))
+        id_div = int(clock[1])
     ids = gids if id_div == 1 else gids // jnp.int32(id_div)
     # drop_mask draws u32 < p·2^32 — reused here as a Bernoulli(p)
     # sampler where "dropped" means "active"
     return drop_mask(
-        jax.random.fold_in(round_key, CLOCK_FOLD), jnp.float32(p), ids
+        jax.random.fold_in(round_key, CLOCK_FOLD), p_arr, ids
     )

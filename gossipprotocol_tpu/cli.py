@@ -32,7 +32,11 @@ the ``report`` subcommand: ``python -m gossipprotocol_tpu report DIR``),
 ``--round-budget``/``--trace-cap`` (convergence observatory: analytic
 round budgets and per-round trace downsampling; live-tail a running dir
 with ``watch DIR``, diff runs with ``report DIR --compare BASELINE``,
-track bench history with ``history``).
+track bench history with ``history``),
+``--sweep``/``--sweep-seeds`` (mega-sweeps: B lanes of traced-value
+variations — seeds, tolerances, activation rates, drop probabilities —
+batched through ONE compiled chunk program under vmap; lane *i* is
+bitwise the standalone run with lane *i*'s config).
 Invalid
 input errors loudly — the reference silently
 no-ops on unknown topologies (``Program.fs:279``) and prints "option
@@ -584,6 +588,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the compiled programs are bitwise identical); set, "
                         "convergence results are STILL bitwise identical — "
                         "counters ride alongside and never feed back")
+    p.add_argument("--sweep", type=str, default=None, metavar="PLAN.json",
+                   help="mega-sweep plan (JSON): {\"axes\": {\"seed\": "
+                        "[...], \"eps\": [...], ...}, \"mode\": \"product\""
+                        "|\"zip\"}. Expands axes that vary only traced "
+                        "values (seed, seed_node, eps, tol, threshold, "
+                        "activation_rate, drop_prob) into B lanes batched "
+                        "through ONE compiled chunk program under vmap — "
+                        "one plan build, one compile, per-lane convergence "
+                        "freezing. Lane i is bitwise the standalone run "
+                        "with lane i's config. Structural axes (topology, "
+                        "algorithm, delivery, ...) are rejected with exit "
+                        "2. Under --devices N only host axes (seed, "
+                        "seed_node) are sweepable")
+    p.add_argument("--sweep-seeds", type=_positive_int, default=None,
+                   metavar="B",
+                   help="seed-sweep sugar: B lanes with seeds --seed, "
+                        "--seed+1, ... --seed+B-1 (equivalent to --sweep "
+                        "with a seed axis; mutually exclusive with it)")
     p.add_argument("--round-budget", type=str, default=None, metavar="N|auto",
                    help="cap the run at N rounds with a structured "
                         "over_budget record instead of grinding to "
@@ -690,7 +712,11 @@ def main(argv=None) -> int:
     from gossipprotocol_tpu.obs.telemetry import NULL as _null_telemetry
     from gossipprotocol_tpu.utils.profiling import maybe_trace
 
-    tel = (Telemetry(args.telemetry_dir, trace_cap=args.trace_cap)
+    # sweep runs keep counters + manifests but not per-round traces
+    # (the trace buffer has no lane story yet — the engine would reject)
+    _sweeping = args.sweep is not None or args.sweep_seeds is not None
+    tel = (Telemetry(args.telemetry_dir, trace_cap=args.trace_cap,
+                     traces=False if _sweeping else None)
            if args.telemetry_dir else _null_telemetry)
 
     algo = _ALGO_ALIASES.get(args.algorithm.lower())
@@ -868,6 +894,26 @@ def main(argv=None) -> int:
                 "leaving a hung or mismatched mesh — recover multi-process "
                 "runs by relaunching the job from --checkpoint-dir"
             )
+        if args.sweep is not None or args.sweep_seeds is not None:
+            if args.sweep is not None and args.sweep_seeds is not None:
+                raise ValueError(
+                    "--sweep and --sweep-seeds are two spellings of one "
+                    "sweep plan — give exactly one"
+                )
+            if args.resume:
+                raise ValueError(
+                    "sweep runs cannot resume from a checkpoint — lanes "
+                    "have no per-lane checkpoint story yet"
+                )
+            from gossipprotocol_tpu.sweep import SweepSpec
+
+            spec = (SweepSpec.from_file(args.sweep)
+                    if args.sweep is not None
+                    else SweepSpec.from_seeds(args.sweep_seeds,
+                                              base_seed=args.seed))
+            # riding RunConfig means the capacity preflight below prices
+            # HBM as lanes x per-run state automatically
+            cfg = dataclasses.replace(cfg, sweep=spec)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -1043,12 +1089,14 @@ def main(argv=None) -> int:
                               tel.wall_s() - _prof_start,
                               trace_dir=args.profile_dir)
     except Exception as e:
-        # routed-delivery build rejections are user input errors that can
-        # only surface once the plan compiler sees the graph — same
-        # loud-exit-2 contract as the preflight checks above
+        # routed-delivery build rejections and sweep-envelope violations
+        # are user input errors that can only surface once the engine
+        # sees the full config — same loud-exit-2 contract as the
+        # preflight checks above
         from gossipprotocol_tpu.ops.delivery import RoutedConfigError
+        from gossipprotocol_tpu.sweep.engine import SweepConfigError
 
-        if isinstance(e, RoutedConfigError):
+        if isinstance(e, (RoutedConfigError, SweepConfigError)):
             if writer:
                 writer.close()
             write_manifest(tel, cfg, topo, None, backend=backend_name,
@@ -1138,6 +1186,13 @@ def main(argv=None) -> int:
         print(f"rounds: {result.rounds}  converged: {result.converged}  "
               f"nodes: {result.num_nodes}  compile: {result.compile_ms:.1f} ms  "
               f"devices: {args.devices}  backend: {backend_name}")
+        lanes = getattr(result, "lanes", 0)
+        if lanes:
+            done = sum(1 for lr in result.lane_records if lr["converged"])
+            rounds = sorted(lr["rounds"] for lr in result.lane_records)
+            print(f"sweep: {lanes} lanes, {done} converged, lane rounds "
+                  f"{rounds[0]}..{rounds[-1]}  "
+                  f"(amortized {result.wall_ms / lanes:.2f} ms/lane)")
         err = result.estimate_error
         if err is not None:
             print(f"push-sum max |s/w - mean| = {err:.3e}")

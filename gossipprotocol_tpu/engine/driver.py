@@ -230,6 +230,15 @@ class RunConfig:
                                    # predictable topology. NOT a
                                    # trajectory field: it only decides
                                    # when the host loop stops
+    sweep: Optional[Any] = None    # sweep.SweepSpec: batch B lanes
+                                   # (seeds/tolerances/rates) through ONE
+                                   # compiled chunk program via vmapped
+                                   # stacked state (sweep/engine.py).
+                                   # None = the ordinary single-run
+                                   # engines. Lane i is bitwise the
+                                   # standalone run with lane i's config,
+                                   # so this is not a trajectory field —
+                                   # it is B trajectories
 
     @property
     def schedule(self):
@@ -1856,6 +1865,15 @@ def run_simulation(
 
     ``initial_state`` resumes from a checkpoint (SURVEY.md §5.4).
     """
+    if cfg.sweep is not None:
+        from gossipprotocol_tpu.sweep.engine import run_sweep
+
+        if initial_state is not None:
+            raise ValueError(
+                "sweep runs cannot resume from a checkpoint — lanes have "
+                "no per-lane checkpoint story yet"
+            )
+        return run_sweep(topo, cfg)
     run_topo = topo
     if (cfg.repair != "off" or cfg.events.has_events) \
             and initial_state is not None:

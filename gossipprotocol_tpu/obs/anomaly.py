@@ -53,7 +53,9 @@ def _record_flags(manifest: Optional[Dict[str, Any]],
                   metrics: List[Dict[str, Any]]) -> List[str]:
     flags: List[str] = []
     result = (manifest or {}).get("result")
-    if result is not None and not result.get("converged", True):
+    if (result is not None and not result.get("converged", True)
+            and not (manifest or {}).get("sweep")):
+        # sweep runs get the lane-resolved flag from _sweep_flags instead
         flags.append("DID NOT CONVERGE within the round budget")
     if any(r.get("stalled") for r in metrics):
         flags.append("gossip STALLED (live spreaders exhausted before quorum)")
@@ -141,6 +143,29 @@ def _shard_flags(manifest: Optional[Dict[str, Any]]) -> List[str]:
     return []
 
 
+def _sweep_flags(manifest: Optional[Dict[str, Any]]) -> List[str]:
+    """Lane-resolved convergence rule for batched sweeps: any lane left
+    unconverged is flagged with the lane tally (replacing the generic
+    DID-NOT-CONVERGE text, which would hide how many lanes finished).
+    Silent on non-sweep manifests and on fully-converged sweeps."""
+    sweep = (manifest or {}).get("sweep")
+    if not isinstance(sweep, dict):
+        return []
+    lanes = sweep.get("lanes")
+    conv = sweep.get("converged_lanes")
+    if (isinstance(lanes, int) and isinstance(conv, int) and conv < lanes):
+        stuck = [lr.get("lane") for lr in sweep.get("per_lane") or []
+                 if not lr.get("converged")]
+        detail = (f" (lanes {', '.join(str(i) for i in stuck[:8])}"
+                  + (", ..." if len(stuck) > 8 else "") + ")"
+                  if stuck else "")
+        return [
+            f"sweep: only {conv}/{lanes} lanes converged within the "
+            f"round budget{detail}"
+        ]
+    return []
+
+
 def _budget_flags(manifest: Optional[Dict[str, Any]],
                   metrics: List[Dict[str, Any]]) -> List[str]:
     flags: List[str] = []
@@ -218,6 +243,7 @@ def anomaly_flags(
     trace rules are skipped without it).
     """
     flags = _record_flags(manifest, metrics)
+    flags += _sweep_flags(manifest)
     flags += _counter_flags(manifest)
     flags += _shard_flags(manifest)
     flags += _budget_flags(manifest, metrics)

@@ -119,7 +119,7 @@ def _state_row_bytes(cfg) -> Tuple[float, int]:
 
     import jax
 
-    probe_cfg = dataclasses.replace(cfg, telemetry=None, seed=0)
+    probe_cfg = dataclasses.replace(cfg, telemetry=None, seed=0, sweep=None)
     topo = build_topology("line", _PROBE_ROWS)
     state, *_ = build_protocol(topo, probe_cfg, num_rows=_PROBE_ROWS)
     row = 0.0
@@ -311,8 +311,15 @@ def estimate_run_bytes(
     B = _dtype_bytes(cfg)
     d = int(cfg.payload_dim)
 
+    # sweep lanes stack per-run state [B, ...] under vmap: everything
+    # per-trajectory (state, workload data, round temporaries, counter
+    # buffers) is paid once per lane; delivery tables stay shared — the
+    # topology is a structural invariant across the sweep
+    sweep = getattr(cfg, "sweep", None)
+    lanes = max(1, int(getattr(sweep, "lanes", 1))) if sweep is not None else 1
+
     row_bytes, fixed_bytes = _state_row_bytes(cfg)
-    state_bytes = int(row_bytes * local_rows) + fixed_bytes
+    state_bytes = (int(row_bytes * local_rows) + fixed_bytes) * lanes
 
     delivery_bytes, path = _delivery_bytes(
         cfg, n_pad, local_rows, num_shards, num_edges, max_degree,
@@ -322,7 +329,7 @@ def estimate_run_bytes(
     # b [rows, samples]
     data_bytes = 0
     if cfg.workload in ("sgp", "gala"):
-        data_bytes = local_rows * int(cfg.sgp_samples) * (d + 1) * B
+        data_bytes = local_rows * int(cfg.sgp_samples) * (d + 1) * B * lanes
 
     # transient estimate: the delivery scratch XLA materializes inside a
     # round (segment_sum accumulators / edge-share vectors), the piece
@@ -336,13 +343,14 @@ def estimate_run_bytes(
             2 * n_pad * (d + 1) * B // num_shards
     else:
         temp_bytes = 2 * n_pad * (d + 1) * B // num_shards
+    temp_bytes *= lanes
 
     telemetry_bytes = 0
     if telemetry_on:
         slots = cfg.resolve_chunk_rounds(
             n, None if implicit_full else num_edges)
         # counters [slots,3] i32 + shard partials + trace [slots,5] f32
-        telemetry_bytes = slots * (12 + 12 + 20)
+        telemetry_bytes = slots * (12 + 12 + 20) * lanes
 
     argument_bytes = state_bytes + delivery_bytes + data_bytes + 16
     total = argument_bytes + temp_bytes + telemetry_bytes
@@ -384,6 +392,7 @@ def estimate_run_bytes(
         "num_nodes": n,
         "num_padded": n_pad,
         "num_devices": num_shards,
+        "lanes": lanes,
         "num_edges": int(num_edges),
         "delivery_path": path,
         "dtype_bytes": B,
@@ -482,13 +491,18 @@ def preflight(topo, cfg, num_devices: int = 1, tel=None) -> Optional[Dict[str, A
         feasible = max_feasible_nodes(
             topo.kind, cfg, num_devices, capacity,
         )
+        lanes = doc.get("lanes", 1)
+        what = (f"{lanes}-lane sweep over {topo.kind}-{topo.num_nodes}"
+                if lanes > 1 else f"{topo.kind}-{topo.num_nodes}")
+        hint = ("shrink the sweep (per-lane state is priced lanes x), "
+                if lanes > 1 else "")
         raise CapacityError(
             f"predicted per-device footprint {_fmt(total)} exceeds "
             f"{int(DEFAULT_SAFETY * 100)}% of device capacity "
-            f"{_fmt(capacity)} ({source}) for {topo.kind}-{topo.num_nodes} "
+            f"{_fmt(capacity)} ({source}) for {what} "
             f"on {num_devices} device(s); max feasible n at this geometry "
-            f"is ~{feasible} (add devices, shrink --payload-dim, or raise "
-            f"$GOSSIP_TPU_HBM_BYTES if the budget is wrong)"
+            f"is ~{feasible} ({hint}add devices, shrink --payload-dim, or "
+            f"raise $GOSSIP_TPU_HBM_BYTES if the budget is wrong)"
         )
     return doc
 

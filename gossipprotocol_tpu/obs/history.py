@@ -103,6 +103,50 @@ def _manifest_records(root: str) -> List[Dict[str, Any]]:
             }
             rec.update(_resource_metrics(os.path.dirname(path)))
             out.append(rec)
+            out.extend(_sweep_records(doc, rec["source"]))
+    return out
+
+
+def _sweep_records(doc: Dict[str, Any],
+                   source: str) -> List[Dict[str, Any]]:
+    """Sweep manifests fan out into the index: one ``sweep_lane`` record
+    per lane (so lane outcomes diff like standalone runs) plus one
+    ``sweep`` rollup row with the converged-lane fraction and the round
+    percentiles. Non-sweep manifests contribute nothing here."""
+    sweep = doc.get("sweep")
+    if not isinstance(sweep, dict):
+        return []
+    cfg = doc.get("config") or {}
+    topo = doc.get("topology") or {}
+    base = {
+        "v": SCHEMA_VERSION,
+        "source": source,
+        "algorithm": cfg.get("algorithm"),
+        "topology": topo.get("kind"),
+        "num_nodes": topo.get("num_nodes"),
+        "backend": doc.get("backend"),
+    }
+    out: List[Dict[str, Any]] = []
+    for lane in sweep.get("per_lane") or []:
+        out.append({
+            **base,
+            "kind": "sweep_lane",
+            "lane": lane.get("lane"),
+            "seed": lane.get("seed"),
+            "overrides": lane.get("overrides"),
+            "converged": lane.get("converged"),
+            "rounds": lane.get("rounds"),
+        })
+    out.append({
+        **base,
+        "kind": "sweep",
+        "lanes": sweep.get("lanes"),
+        "converged_fraction": sweep.get("converged_fraction"),
+        "rounds_p50": sweep.get("rounds_p50"),
+        "rounds_p95": sweep.get("rounds_p95"),
+        "rounds_max": sweep.get("rounds_max"),
+        "over_budget": sweep.get("over_budget"),
+    })
     return out
 
 
@@ -197,6 +241,22 @@ def render_history(records: List[Dict[str, Any]], out: TextIO,
                 line += f", {r['actual_over_predicted']:.2f}x predicted"
             if isinstance(r.get("peak_rss_bytes"), (int, float)):
                 line += f", peak RSS {r['peak_rss_bytes'] / 2**20:.0f} MiB"
+            line += f"  ({r['source']})"
+            out.write(line + "\n")
+    sweeps = [r for r in records if r["kind"] == "sweep"]
+    if sweeps:
+        out.write(f"\nindexed sweeps ({len(sweeps)}):\n")
+        for r in sweeps:
+            line = (f"  {r.get('algorithm', '?')} on "
+                    f"{r.get('topology', '?')}-{r.get('num_nodes', '?')}: "
+                    f"{r.get('lanes', '?')} lanes")
+            if isinstance(r.get("converged_fraction"), (int, float)):
+                line += f", {r['converged_fraction']:.0%} converged"
+            if r.get("rounds_p50") is not None:
+                line += (f", rounds p50 {r['rounds_p50']:.0f}"
+                         f" / p95 {r['rounds_p95']:.0f}")
+            if r.get("over_budget"):
+                line += ", OVER BUDGET"
             line += f"  ({r['source']})"
             out.write(line + "\n")
 

@@ -23,8 +23,9 @@ from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
 
 # runtime-only fields that either cannot serialize (callbacks, the
 # telemetry hub itself) or are captured in richer form elsewhere
+# ("sweep" lands as the top-level sweep rollup with per-lane records)
 _SKIP_CONFIG_FIELDS = ("metrics_callback", "telemetry", "fault_schedule",
-                       "fault_plan", "event_plan")
+                       "fault_plan", "event_plan", "sweep")
 
 
 def config_doc(cfg) -> Dict[str, Any]:
@@ -121,6 +122,10 @@ def build_manifest(
         # sent/delivered/dropped totals + skew; None off / single-device
         "shard_balance": (tel.shard_balance()
                           if hasattr(tel, "shard_balance") else None),
+        # sweep rollup (lanes, converged fraction, round percentiles,
+        # per-lane records) when the run was a batched sweep; None for
+        # single-trajectory runs
+        "sweep": getattr(tel, "sweep", None),
         # jax.profiler trace dir when the run was profiled
         "profile_dir": getattr(tel, "profile_dir", None),
         # sibling resources.json (compiled-program cost/memory analysis,

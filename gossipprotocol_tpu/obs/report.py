@@ -312,6 +312,39 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
                 f" |Σw − n| ≤ {manifest.get('max_w_drift_ulps', 0.0):g} ULPs\n"
             )
 
+    # sweep rollup --------------------------------------------------------
+    sweep = (manifest or {}).get("sweep")
+    if sweep:
+        frac = sweep.get("converged_fraction")
+        out.write(
+            f"\nsweep: {sweep.get('lanes', '?')} lanes, "
+            f"{sweep.get('converged_lanes', '?')} converged"
+            + (f" ({frac:.0%})" if isinstance(frac, (int, float)) else "")
+            + f", rounds p50 {sweep.get('rounds_p50', 0):.0f}"
+            f" / p95 {sweep.get('rounds_p95', 0):.0f}"
+            f" / max {sweep.get('rounds_max', '?')}"
+            + ("  OVER BUDGET" if sweep.get("over_budget") else "")
+            + "\n"
+        )
+        spec = sweep.get("spec") or {}
+        axes = spec.get("axes")
+        if axes:
+            out.write(f"  axes ({spec.get('mode', 'product')}): "
+                      + ", ".join(f"{k}[{len(v)}]" for k, v in axes.items())
+                      + "\n")
+        lanes = sweep.get("per_lane") or []
+        shown = lanes[:16]
+        for lr in shown:
+            over = lr.get("overrides") or {}
+            desc = ", ".join(f"{k}={v}" for k, v in over.items()) or "-"
+            out.write(
+                f"  lane {lr.get('lane', '?'):>3}  {desc:<28} "
+                f"{'converged' if lr.get('converged') else 'NOT converged'}"
+                f" @ {lr.get('rounds', '?')} rounds\n")
+        if len(lanes) > len(shown):
+            out.write(f"  ... {len(lanes) - len(shown)} more lanes "
+                      "(see run.json / run_index.jsonl)\n")
+
     # resource observatory -----------------------------------------------
     _render_resources(data, manifest, out)
 

@@ -91,6 +91,7 @@ class Telemetry:
     enabled = True
     prediction = None  # obs.predict round prediction, set by the driver
     profile_dir = None  # jax.profiler trace dir when --profile-dir is set
+    sweep = None  # sweep rollup (lanes, per-lane records), set by _drive_sweep
 
     def __init__(self, out_dir: str, *, counters: bool = True,
                  traces: Optional[bool] = None,
@@ -379,6 +380,7 @@ class NullTelemetry:
     attribution_on = False
     prediction = None
     profile_dir = None
+    sweep = None
     shard_totals = None
     dir = None
 

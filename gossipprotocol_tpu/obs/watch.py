@@ -51,6 +51,21 @@ def _frame(data: Dict[str, Any], out: TextIO) -> bool:
         )
     else:
         out.write("  (running)\n")
+    sweep = (manifest or {}).get("sweep")
+    if sweep:
+        # finished sweep: the manifest rollup is authoritative
+        out.write(
+            f"sweep     lanes converged "
+            f"{sweep.get('converged_lanes', 0)}/{sweep.get('lanes', '?')}"
+            f"  rounds p50 {sweep.get('rounds_p50', 0):.0f}"
+            f" / p95 {sweep.get('rounds_p95', 0):.0f}\n")
+    elif "lanes" in last:
+        # still running: the latest chunk record carries the lane tally
+        out.write(
+            f"sweep     lanes converged "
+            f"{last.get('lanes_done', 0)}/{last['lanes']}"
+            f"  slowest lane at round {last.get('round', 0)}"
+            f" (fastest frozen at {last.get('rounds_min', 0)})\n")
     alive = last.get("alive")
     if alive:
         out.write(

@@ -323,6 +323,7 @@ def pad_neighbors(nbrs, n_padded: int):
 def make_sharded_chunk_runner(
     topo: Topology, cfg: RunConfig, mesh: Mesh, allow_all_alive: bool = True,
     nbrs_override=None, counter_slots: Optional[int] = None,
+    lane_cfgs=None,
 ):
     """jitted ``(state, nbrs, seed, round_limit) -> state`` advancing one
     chunk under shard_map. Returns (runner, initial padded+placed state,
@@ -339,7 +340,13 @@ def make_sharded_chunk_runner(
     chunk sizing for the *birth* topology (``run_simulation_sharded``
     passes it; a repaired topology can resolve a different chunk size,
     and a too-small buffer would silently clamp delta rows together).
-    Defaults to this topology's own resolved chunk size."""
+    ``lane_cfgs``: per-lane RunConfigs for a vmapped mega-sweep
+    (sweep/engine.py). The shard_map'd chunk is left byte-identical —
+    lanes compose as ``jax.vmap`` OUTSIDE it over (state, seed), so the
+    per-lane program inside the mesh is the literal sharded chunk and
+    inherits its single-chip-equal contract. Only host-consumed axes
+    (seed, seed_node) may differ between the lane configs; the sweep
+    engine validates that before calling."""
     n = topo.num_nodes
     num_shards = int(mesh.devices.size)
     n_padded = padded_size(n, num_shards)
@@ -756,6 +763,31 @@ def make_sharded_chunk_runner(
         out_specs=(specs, stats_specs),
         check_vma=False,
     )
+    if lane_cfgs is not None:
+        # mega-sweep: vmap the UNCHANGED shard_map'd chunk over a leading
+        # lane axis of (state, seed). Per-lane initial states re-run
+        # build_protocol (seed_node draws differ per lane); nbrs and
+        # round_limit broadcast. The while_loop batching rule freezes a
+        # done lane's whole carry bitwise while others keep running.
+        lane_states = [
+            build_protocol(topo, lc, num_rows=n_padded,
+                           allow_all_alive=allow_all_alive)[0]
+            for lc in lane_cfgs
+        ]
+        state0 = jax.tree.map(lambda *xs: jnp.stack(xs), *lane_states)
+        lane_specs = jax.tree.map(
+            lambda s: P(*((None,) + tuple(s))), specs)
+        runner = jax.jit(
+            jax.vmap(sm, in_axes=(0, None, 0, None)), donate_argnums=0)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), lane_specs)
+        state0 = jax.device_put(state0, shardings)
+        if nbrs is not None and not sgp_bundle:
+            nbrs = jax.device_put(
+                nbrs,
+                node_sharding(mesh) if nbrs_sharded else replicated(mesh),
+            )
+        return runner, state0, nbrs, done_fn, shardings
     runner = jax.jit(sm, donate_argnums=0)
 
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
@@ -789,6 +821,16 @@ def run_simulation_sharded(
     ``initial_state`` resumes from a (trimmed) checkpoint: it is re-padded
     to the mesh and takes over from its recorded round.
     """
+    if cfg.sweep is not None:
+        from gossipprotocol_tpu.sweep.engine import run_sweep_sharded
+
+        if initial_state is not None:
+            raise ValueError(
+                "sweep runs cannot resume from a checkpoint — lanes have "
+                "no per-lane checkpoint story yet"
+            )
+        return run_sweep_sharded(
+            topo, cfg, num_devices=num_devices, mesh=mesh, backend=backend)
     from gossipprotocol_tpu.engine.driver import use_megakernel
 
     if use_megakernel(cfg):

@@ -200,7 +200,14 @@ def loss_probability(rnd: jax.Array, windows) -> jax.Array:
     survive = jnp.float32(1.0)
     for start, stop, prob in windows:
         active = (rnd >= jnp.int32(start)) & (rnd < jnp.int32(stop))
-        survive = survive * jnp.where(active, jnp.float32(1.0 - prob), 1.0)
+        if isinstance(prob, jax.Array):
+            # traced entry (sweep lanes): the value is the host-rounded
+            # float32 SURVIVE factor 1 - p, passed pre-complemented so
+            # the single rounding step matches the static program bitwise
+            keep = jnp.asarray(prob, jnp.float32)
+        else:
+            keep = jnp.float32(1.0 - prob)
+        survive = survive * jnp.where(active, keep, 1.0)
     return jnp.float32(1.0) - survive
 
 

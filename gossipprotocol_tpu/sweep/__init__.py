@@ -1,0 +1,36 @@
+"""Vmapped mega-sweeps: B independent runs through one compiled program.
+
+A :class:`SweepSpec` expands axes that vary only *traced values* (seeds,
+tolerances, activation rates, drop probabilities) into ``[B, ...]``-stacked
+per-lane state that rides the existing chunk runner under ``jax.vmap`` —
+one plan build, one compile, B lanes. Structural axes (topology, shape,
+protocol, delivery, event plans) are rejected loudly: they change the
+compiled program and belong in separate sweeps.
+"""
+
+from gossipprotocol_tpu.sweep.spec import (  # noqa: F401
+    HOST_AXES,
+    SGP_AXES,
+    STRUCTURAL_AXES,
+    TRACED_AXES,
+    SweepSpec,
+)
+
+__all__ = [
+    "SweepSpec",
+    "HOST_AXES",
+    "TRACED_AXES",
+    "STRUCTURAL_AXES",
+    "SGP_AXES",
+    "run_sweep",
+    "run_sweep_sharded",
+    "SweepResult",
+]
+
+
+def __getattr__(name):  # lazy: spec parsing must not import the engine
+    if name in ("run_sweep", "run_sweep_sharded", "SweepResult"):
+        from gossipprotocol_tpu.sweep import engine
+
+        return getattr(engine, name)
+    raise AttributeError(name)

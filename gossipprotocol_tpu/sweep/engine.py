@@ -1,0 +1,639 @@
+"""The batched execution path: B lanes through one compiled chunk program.
+
+Single-chip sweeps vmap the existing chunk body over a leading lane axis;
+sharded sweeps vmap *outside* ``shard_map`` (the per-lane program inside
+the mesh is the literal sharded chunk, so the single-chip-equal contract
+is inherited). Per-lane convergence freezing is free: JAX's while_loop
+batching rule runs the loop while ANY lane's cond holds and select-masks
+the body per lane, so a converged lane's entire carry — state, counters,
+round — stops updating bitwise.
+
+Bitwise lane contract: lane *i* of a B-lane sweep equals the standalone
+run with lane *i*'s config. Unswept parameters are baked as the same
+Python constants the standalone program bakes; swept parameters enter as
+per-lane traced scalars pre-rounded on the host to the exact float32
+values the standalone trace would bake (see ``_lane_params``), so every
+comparison and draw threshold matches bit for bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu.obs import as_telemetry
+from gossipprotocol_tpu.topology.base import Topology
+
+
+class SweepConfigError(ValueError):
+    """A config outside the sweep envelope (structural variation, or a
+    feature the batched path does not carry yet)."""
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """Rollup + per-lane outcomes of one batched sweep.
+
+    Quacks like :class:`~gossipprotocol_tpu.engine.driver.RunResult` for
+    the CLI/manifest surface: ``converged`` is the ALL-lanes rollup,
+    ``rounds`` the slowest lane, ``final_state`` the ``[B, ...]``-stacked
+    (trimmed) lane states.
+    """
+
+    converged: bool
+    rounds: int
+    wall_ms: float
+    compile_ms: float
+    num_nodes: int
+    algorithm: str
+    final_state: Any
+    metrics: List[dict]
+    lanes: int = 0
+    lane_records: List[dict] = dataclasses.field(default_factory=list)
+    checkpoints: List[str] = dataclasses.field(default_factory=list)
+
+    def lane_state(self, lane: int):
+        """Lane ``lane``'s final state, unstacked — the pytree a
+        standalone run with that lane's config returns."""
+        return jax.tree.map(lambda x: x[lane], self.final_state)
+
+    @property
+    def estimate_error(self) -> Optional[float]:
+        """Max per-lane push-sum estimate error (lanes average
+        independently — a cross-lane mean would be meaningless)."""
+        from gossipprotocol_tpu.engine.driver import RunResult
+
+        errs = []
+        for i in range(self.lanes):
+            err = RunResult(
+                converged=True, rounds=0, wall_ms=0.0, compile_ms=0.0,
+                num_nodes=self.num_nodes, algorithm=self.algorithm,
+                final_state=self.lane_state(i), metrics=[],
+            ).estimate_error
+            if err is not None:
+                errs.append(err)
+        return max(errs) if errs else None
+
+
+def _validate_envelope(topo: Topology, cfg, spec, *, sharded: bool) -> None:
+    """Loud rejection of configs the batched path does not carry.
+
+    The envelope is the plain round-loop: gossip (scatter or inverted
+    dense) and single-target push-sum (scatter/invert), workload='avg',
+    no acceleration, no host events. Everything else either compiles a
+    structure vmap cannot share (routed/pallas/megakernel plans, SGP
+    bundles) or needs host work the lane loop does not fan out yet.
+    """
+    if cfg.algorithm not in ("gossip", "push-sum"):
+        raise SweepConfigError(
+            f"sweeps support algorithm 'gossip' or 'push-sum', not "
+            f"{cfg.algorithm!r}"
+        )
+    if cfg.workload != "avg":
+        raise SweepConfigError(
+            f"sweeps support workload='avg' only (got {cfg.workload!r}); "
+            "SGP/GALA lanes need the training state in the envelope"
+        )
+    if cfg.algorithm == "push-sum" and cfg.fanout != "one":
+        raise SweepConfigError(
+            "sweeps support fanout='one' push-sum only — the diffusion "
+            "round shares its edge slabs in ways the lane axis does not "
+            "thread yet"
+        )
+    if cfg.delivery not in ("scatter", "invert"):
+        raise SweepConfigError(
+            f"sweeps support delivery 'scatter' or 'invert', not "
+            f"{cfg.delivery!r} — routed/pallas/megakernel plans are "
+            "compiled per-run structures"
+        )
+    if cfg.accel != "off":
+        raise SweepConfigError("sweeps do not carry accelerated gossip yet")
+    if cfg.events.has_events:
+        raise SweepConfigError(
+            "sweeps cannot replay topology-schedule events — the event "
+            "plan rewrites shared structure mid-run"
+        )
+    if cfg.schedule.has_strikes:
+        raise SweepConfigError(
+            "sweeps cannot carry kill/revive strikes yet (host events "
+            "stop the chunk per lane); loss windows are fine"
+        )
+    if cfg.repair != "off":
+        raise SweepConfigError("sweeps cannot carry repair policies")
+    if cfg.checkpoint_every or cfg.checkpoint_dir:
+        raise SweepConfigError("sweep runs don't checkpoint yet")
+    if cfg.round_budget == "auto":
+        raise SweepConfigError(
+            "round_budget='auto' is per-run analytic; give sweeps an "
+            "explicit integer budget"
+        )
+    tel = as_telemetry(cfg.telemetry)
+    if tel.traces_on:
+        raise SweepConfigError(
+            "sweep runs don't record per-round traces yet — counters "
+            "and manifests are lane-aware, traces are not"
+        )
+    if sharded and spec.traced_names:
+        raise SweepConfigError(
+            "sharded sweeps support host axes (seed, seed_node) only; "
+            f"traced axes {spec.traced_names} need the single-chip engine"
+        )
+    if "drop_prob" in spec.axis_names and len(cfg.schedule.loss) > 1:
+        raise SweepConfigError(
+            "sweep axis 'drop_prob' needs at most one loss window on "
+            "the base config"
+        )
+    if "activation_rate" in spec.axis_names and cfg.clock != "poisson":
+        raise SweepConfigError(
+            "sweep axis 'activation_rate' needs --clock poisson on the "
+            "base config (the sync clock compiles activation out)"
+        )
+
+
+def _state_dtype(cfg) -> np.dtype:
+    return np.dtype(jnp.dtype(cfg.dtype).name)
+
+
+def _lane_params(spec, lane_cfgs, cfg) -> dict:
+    """Per-lane traced parameter arrays, pre-rounded on the host.
+
+    The rounding discipline is the bitwise contract: the standalone
+    program bakes ``float32(1 - p)`` / ``float32(1 - exp(-r))`` in ONE
+    f64→f32 rounding step, so the lane arrays must be produced by the
+    identical computation — never by rounding the inputs first.
+    """
+    dt = _state_dtype(cfg)
+    params = {}
+    for name in spec.traced_names:
+        if name == "eps":
+            params["eps"] = jnp.asarray(
+                np.asarray([lc.eps for lc in lane_cfgs], dt))
+        elif name == "tol":
+            params["tol"] = jnp.asarray(
+                np.asarray([lc.tol for lc in lane_cfgs], dt))
+        elif name == "threshold":
+            params["threshold"] = jnp.asarray(
+                [lc.threshold + (1 if lc.semantics == "reference" else 0)
+                 for lc in lane_cfgs], jnp.int32)
+        elif name == "activation_rate":
+            params["activation_prob"] = jnp.asarray(np.asarray(
+                [np.float32(1.0 - math.exp(-lc.activation_rate))
+                 for lc in lane_cfgs], np.float32))
+        elif name == "drop_prob":
+            params["drop_keep"] = jnp.asarray(np.asarray(
+                [np.float32(1.0 - lc.schedule.loss[0].prob)
+                 for lc in lane_cfgs], np.float32))
+    return params
+
+
+def _make_lane_chunk(topo: Topology, cfg, spec, *, done_fn, extra_stats,
+                     all_alive: bool, targets_alive: bool,
+                     counter_slots: Optional[int]):
+    """One lane's ``(state, nbrs, base_key, lane, round_limit)`` chunk —
+    the function :func:`run_sweep` vmaps over the lane axis.
+
+    With no traced axes the round body is the template's own bound core
+    (the literal standalone trace); with traced axes the body calls the
+    un-jitted ``*_round_core`` with the jitted wrapper's exact closure,
+    swapping swept constants for the lane's traced scalars.
+    """
+    from gossipprotocol_tpu.engine.driver import (
+        effective_keep_alive, gossip_inversion_enabled, mass_stats,
+        run_clock_spec, stats_with_extra,
+    )
+
+    n = topo.num_nodes
+    is_pushsum = cfg.algorithm != "gossip"
+    ref = cfg.semantics == "reference"
+    traced = set(spec.traced_names)
+    loss_windows = cfg.schedule.static_loss_windows()
+    clock = run_clock_spec(topo, cfg)
+    threshold0 = cfg.threshold + 1 if ref else cfg.threshold
+    keep_alive = (effective_keep_alive(topo, cfg)
+                  if not is_pushsum else cfg.keep_alive)
+    inverted = (not is_pushsum) and gossip_inversion_enabled(topo, cfg)
+    if "drop_prob" in traced and not loss_windows:
+        # lane_config synthesized a whole-run window per lane; mirror its
+        # bounds for the traced rewrite below
+        loss_windows = ((0, cfg.max_rounds, 0.0),)
+
+    def lane_env(lane):
+        """(loss_windows, clock) with this lane's traced values spliced."""
+        lw, ck = loss_windows, clock
+        if "drop_keep" in lane:
+            (start, stop, _), = loss_windows
+            lw = ((start, stop, lane["drop_keep"]),)
+        if "activation_prob" in lane:
+            ck = ("prob", lane["activation_prob"], int(clock[1]))
+        return lw, ck
+
+    def round_core(s, nbrs, base_key, lane):
+        lw, ck = lane_env(lane)
+        if is_pushsum:
+            from gossipprotocol_tpu.protocols.pushsum import (
+                pushsum_round_core,
+            )
+
+            def scatter(s_sent, w_sent, targets):
+                return (
+                    jax.ops.segment_sum(s_sent, targets, num_segments=n),
+                    jax.ops.segment_sum(w_sent, targets, num_segments=n),
+                )
+
+            return pushsum_round_core(
+                s, nbrs, base_key, n=n, gids=None, scatter=scatter,
+                alive_global=s.alive,
+                eps=lane.get("eps", cfg.eps),
+                streak_target=cfg.streak_target,
+                reference_semantics=ref,
+                predicate=cfg.predicate,
+                tol=lane.get("tol", cfg.tol),
+                all_alive=all_alive,
+                targets_alive=targets_alive,
+                delivery=cfg.delivery,
+                loss_windows=lw,
+                clock=ck,
+            )
+        from gossipprotocol_tpu.protocols.gossip import gossip_round_core
+
+        return gossip_round_core(
+            s, nbrs, base_key, n=n, gids=None,
+            scatter=lambda v, t: jax.ops.segment_sum(v, t, num_segments=n),
+            threshold=lane.get("threshold", threshold0),
+            keep_alive=keep_alive,
+            all_alive=all_alive,
+            inverted=inverted,
+            loss_windows=lw,
+            clock=ck,
+        )
+
+    def counter_fn(s, s2, nbrs, base_key, lane):
+        lw, ck = lane_env(lane)
+        if is_pushsum:
+            from gossipprotocol_tpu.protocols.pushsum import (
+                pushsum_message_counts,
+            )
+
+            return pushsum_message_counts(
+                s, nbrs, base_key, n=n, gids=None, all_alive=all_alive,
+                targets_alive=targets_alive, delivery=cfg.delivery,
+                loss_windows=lw, alive_global=s.alive, clock=ck,
+            )
+        from gossipprotocol_tpu.protocols.gossip import gossip_message_counts
+
+        return gossip_message_counts(
+            s, s2, nbrs, base_key, n=n, gids=None, keep_alive=keep_alive,
+            all_alive=all_alive, loss_windows=lw, clock=ck,
+        )
+
+    if counter_slots is None:
+        def chunk(state, nbrs, base_key, lane, round_limit):
+            def body(s):
+                return round_core(s, nbrs, base_key, lane)
+
+            def cond(s):
+                return jnp.logical_and(~done_fn(s), s.round < round_limit)
+
+            final = jax.lax.while_loop(cond, body, state)
+            return final, stats_with_extra(final, done_fn, extra_stats)
+
+        return chunk
+
+    def chunk(state, nbrs, base_key, lane, round_limit):
+        start = state.round  # chunk entry round: buffer row 0
+
+        def body(carry):
+            s, buf = carry
+            s2 = round_core(s, nbrs, base_key, lane)
+            delta = counter_fn(s, s2, nbrs, base_key, lane)
+            buf = jax.lax.dynamic_update_slice(
+                buf, delta[None, :], (s.round - start, jnp.int32(0)))
+            return s2, buf
+
+        def cond(carry):
+            s, _ = carry
+            return jnp.logical_and(~done_fn(s), s.round < round_limit)
+
+        buf0 = jnp.zeros((counter_slots, 3), jnp.int32)
+        final, buf = jax.lax.while_loop(cond, body, (state, buf0))
+        stats = stats_with_extra(final, done_fn, extra_stats)
+        stats["counters"] = buf
+        stats.update(mass_stats(final))
+        return final, stats
+
+    return chunk
+
+
+def _stack_states(states):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def run_sweep(topo: Topology, cfg) -> SweepResult:
+    """Single-chip batched sweep: one plan build, one compile, B lanes."""
+    from gossipprotocol_tpu.engine.driver import (
+        build_protocol, device_arrays, warm_start,
+    )
+
+    spec = cfg.sweep
+    template = dataclasses.replace(cfg, sweep=None)
+    _validate_envelope(topo, template, spec, sharded=False)
+    tel = as_telemetry(cfg.telemetry)
+    B = spec.lanes
+    lane_cfgs = [spec.lane_config(template, i) for i in range(B)]
+    n = topo.num_nodes
+
+    with tel.span("protocol_build", engine="sweep", lanes=B):
+        built = [build_protocol(topo, lc) for lc in lane_cfgs]
+        _, core0, done_fn, extra_stats, (all_alive, targets_alive) = built[0]
+        state = _stack_states([b[0] for b in built])
+    with tel.span("plan_compile", engine="sweep"):
+        # ONE build for the whole sweep — the shared-structure contract
+        nbrs = device_arrays(topo, template, tel=tel)
+    tel.event("plan_cache", provenance="sweep-shared", builds=1, lanes=B,
+              design="vmap")
+
+    base_key = jnp.stack(
+        [jax.random.key(lc.seed) for lc in lane_cfgs])
+    lane_params = _lane_params(spec, lane_cfgs, template)
+
+    edges = None if topo.implicit_full else int(topo.indices.size)
+    counter_slots = (template.resolve_chunk_rounds(n, edges)
+                     if tel.counters_on else None)
+    if spec.traced_names or counter_slots is not None:
+        chunk = _make_lane_chunk(
+            topo, template, spec, done_fn=done_fn, extra_stats=extra_stats,
+            all_alive=all_alive, targets_alive=targets_alive,
+            counter_slots=counter_slots,
+        )
+    else:
+        from gossipprotocol_tpu.engine.driver import stats_with_extra
+
+        # host-axes-only sweep: the template's own bound core IS every
+        # lane's round — vmap the literal standalone chunk body
+        def chunk(state, nbrs, base_key, lane, round_limit):
+            def body(s):
+                return core0(s, nbrs, base_key)
+
+            def cond(s):
+                return jnp.logical_and(~done_fn(s), s.round < round_limit)
+
+            final = jax.lax.while_loop(cond, body, state)
+            return final, stats_with_extra(final, done_fn, extra_stats)
+
+    runner = jax.jit(
+        jax.vmap(chunk, in_axes=(0, None, 0, 0, None)), donate_argnums=0)
+
+    t0 = time.perf_counter()
+    with tel.span("jit_compile", engine="sweep", lanes=B):
+        compiled = runner.lower(
+            state, nbrs, base_key, lane_params, jnp.int32(0)).compile()
+    tel.record_compiled("chunk", compiled, engine="sweep", lanes=B)
+
+    def step(s, round_limit):
+        return compiled(s, nbrs, base_key, lane_params,
+                        jnp.int32(round_limit))
+
+    with tel.span("warm_start"):
+        state = warm_start(step, state)
+    compile_ms = (time.perf_counter() - t0) * 1e3
+
+    def trim(s):
+        return jax.tree.map(
+            lambda x: x[:, :n] if jnp.ndim(x) >= 2 else x, s)
+
+    return _drive_sweep(topo, template, spec, lane_cfgs, state, step,
+                        compile_ms, tel, trim=trim)
+
+
+def run_sweep_sharded(
+    topo: Topology,
+    cfg,
+    num_devices: Optional[int] = None,
+    mesh=None,
+    backend: Optional[str] = None,
+) -> SweepResult:
+    """Sharded batched sweep: vmap over lanes OUTSIDE ``shard_map``.
+
+    The per-lane program inside the mesh is the literal sharded chunk
+    (seed is already a runtime scalar there), so host axes — seed,
+    seed_node — are the sweepable set; traced axes are single-chip only
+    for now and rejected loudly.
+    """
+    from gossipprotocol_tpu.engine.driver import warm_start
+    from gossipprotocol_tpu.parallel.mesh import make_mesh
+    from gossipprotocol_tpu.parallel.sharded import make_sharded_chunk_runner
+
+    spec = cfg.sweep
+    template = dataclasses.replace(cfg, sweep=None)
+    _validate_envelope(topo, template, spec, sharded=True)
+    tel = as_telemetry(cfg.telemetry)
+    B = spec.lanes
+    lane_cfgs = [spec.lane_config(template, i) for i in range(B)]
+    if mesh is None:
+        devices = jax.devices(backend) if backend else None
+        mesh = make_mesh(num_devices, devices=devices)
+    n = topo.num_nodes
+
+    with tel.span("topology_arrays", engine="sweep-sharded", lanes=B):
+        runner, state, nbrs, done_fn, _ = make_sharded_chunk_runner(
+            topo, template, mesh, lane_cfgs=lane_cfgs,
+        )
+    tel.event("plan_cache", provenance="sweep-shared", builds=1, lanes=B,
+              design="vmap-of-shard_map",
+              num_shards=int(mesh.devices.size))
+    seeds = jnp.asarray([lc.seed for lc in lane_cfgs], jnp.int32)
+
+    t0 = time.perf_counter()
+    with tel.span("jit_compile", engine="sweep-sharded", lanes=B):
+        compiled = runner.lower(state, nbrs, seeds, jnp.int32(0)).compile()
+    tel.record_compiled(
+        "chunk", compiled, engine="sweep-sharded", lanes=B,
+        num_shards=int(mesh.devices.size))
+
+    def step(s, round_limit):
+        return compiled(s, nbrs, seeds, jnp.int32(round_limit))
+
+    with tel.span("warm_start"):
+        state = warm_start(step, state)
+    compile_ms = (time.perf_counter() - t0) * 1e3
+
+    def trim(s):
+        return jax.tree.map(
+            lambda x: x[:, :n] if jnp.ndim(x) >= 2 else x, s)
+
+    return _drive_sweep(topo, template, spec, lane_cfgs, state, step,
+                        compile_ms, tel, trim=trim)
+
+
+def _drive_sweep(topo, cfg, spec, lane_cfgs, state, step, compile_ms,
+                 tel, *, trim) -> SweepResult:
+    """Host loop over lane-stacked chunks.
+
+    Mirrors ``engine.driver._drive`` with a ``[B]`` view of every stat:
+    one device fetch per chunk, chunk advancement until every lane's
+    predicate holds (lanes past theirs are frozen on device) or the
+    round bound / budget hits. Counters fold per lane, then sum across
+    lanes into the telemetry totals.
+    """
+    from gossipprotocol_tpu.obs.counters import ulp_drift
+    from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
+
+    B = spec.lanes
+    n = topo.num_nodes
+    chunk_rounds = cfg.resolve_chunk_rounds(
+        n, None if topo.implicit_full else int(topo.indices.size))
+    budget = int(cfg.round_budget) if cfg.round_budget is not None else None
+    metrics: List[dict] = []
+    lane_counters = np.zeros((B, 3), np.int64)
+    prev_rounds = np.asarray(
+        jax.device_get(state.round), np.int64).reshape(B).copy()
+    cur_round = int(prev_rounds.max())
+    mass_base = None
+    if tel.counters_on:
+        with tel.span("mass_baseline"):
+            state, _bs = step(state, -1)
+            _bh = jax.device_get(_bs)
+        if "mass_s" in _bh:
+            mass_base = (np.asarray(_bh["mass_s"]),
+                         np.asarray(_bh["mass_w"]))
+    done = np.zeros(B, bool)
+    over_budget = False
+    stalled = False
+
+    t0 = time.perf_counter()
+    while True:
+        if cur_round >= cfg.max_rounds:
+            break
+        round_limit = min(cur_round + chunk_rounds, cfg.max_rounds)
+        if budget is not None:
+            round_limit = min(round_limit, budget)
+        chunk_start_rounds = prev_rounds
+        with tel.span("chunk", round_start=cur_round,
+                      round_limit=round_limit, lanes=B):
+            state, stats = step(state, round_limit)
+            host = jax.device_get(stats)
+        rounds = np.asarray(host.pop("round"), np.int64).reshape(B)
+        done = np.asarray(host.pop("done"), bool).reshape(B)
+        counters = host.pop("counters", None)
+        host.pop("shard_counters", None)  # per-lane attribution: not folded
+        chunk_mass = (host.pop("mass_s", None), host.pop("mass_w", None))
+        cur_round = int(rounds.max())
+        prev_rounds = rounds.copy()
+        rec = {
+            "round": cur_round,
+            "lanes": B,
+            "lanes_done": int(done.sum()),
+            "rounds_min": int(rounds.min()),
+        }
+        for k, v in host.items():
+            v = np.asarray(v)
+            # lane-summed node tallies; min/max stats take the envelope
+            if k == "ratio_min":
+                rec[k] = float(v.min())
+            elif k == "ratio_max":
+                rec[k] = float(v.max())
+            else:
+                rec[k] = int(v.astype(np.int64).sum())
+        if counters is not None:
+            ctr = np.asarray(counters, np.int64)  # [B, slots, 3]
+            for i in range(B):
+                valid = int(rounds[i] - chunk_start_rounds[i])
+                lane_counters[i] += ctr[i, :valid].sum(axis=0)
+            sent, delivered, dropped = (
+                int(x) for x in ctr.sum(axis=(0, 1)))
+            rec["sent"], rec["delivered"], rec["dropped"] = (
+                sent, delivered, dropped)
+            tel.add_counters(sent, delivered, dropped)
+        if chunk_mass[0] is not None and mass_base is not None:
+            s_ulps = max(
+                ulp_drift(a, b) for a, b in
+                zip(np.atleast_1d(chunk_mass[0]).ravel(),
+                    np.atleast_1d(mass_base[0]).ravel()))
+            w_ulps = max(
+                ulp_drift(a, b) for a, b in
+                zip(np.atleast_1d(chunk_mass[1]).ravel(),
+                    np.atleast_1d(mass_base[1]).ravel()))
+            rec["mass_drift_ulps"] = s_ulps
+            rec["w_drift_ulps"] = w_ulps
+            tel.note_mass_drift(s_ulps, w_ulps)
+        no_progress = bool((rounds == chunk_start_rounds).all())
+        stalled = (not done.all()) and (
+            rec.get("spreading") == 0 or no_progress)
+        if stalled:
+            rec["stalled"] = True
+        metrics.append(rec)
+        tel.metric(rec)
+        if cfg.metrics_callback:
+            cfg.metrics_callback(rec)
+        if budget is not None and not done.all() and cur_round >= budget:
+            over_budget = True
+            ob = {
+                "event": "over_budget",
+                "round": cur_round,
+                "budget_rounds": budget,
+                "budget_source": "explicit",
+                "lanes_done": int(done.sum()),
+            }
+            metrics.append(ob)
+            tel.metric(ob)
+            tel.event("over_budget", **{k: v for k, v in ob.items()
+                                        if k != "event"})
+            if cfg.metrics_callback:
+                cfg.metrics_callback(ob)
+        if done.all() or stalled or over_budget:
+            break
+    with tel.span("device_sync"):
+        jax.block_until_ready(state)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+
+    final_state = jax.tree.map(
+        np.array, ckpt_mod.fetch_host(trim(state)))
+    lane_rounds = prev_rounds
+    lane_records = []
+    for i in range(B):
+        lr = {
+            "lane": i,
+            "overrides": spec.lane_overrides(i),
+            "converged": bool(done[i]),
+            "rounds": int(lane_rounds[i]),
+            "seed": int(lane_cfgs[i].seed),
+        }
+        if tel.counters_on:
+            lr["sent"], lr["delivered"], lr["dropped"] = (
+                int(x) for x in lane_counters[i])
+        lane_records.append(lr)
+    q50, q95 = (float(np.quantile(lane_rounds.astype(float), q))
+                for q in (0.5, 0.95))
+    tel.sweep = {
+        "lanes": B,
+        "converged_lanes": int(done.sum()),
+        "converged_fraction": float(done.mean()),
+        "rounds_p50": q50,
+        "rounds_p95": q95,
+        "rounds_max": int(lane_rounds.max()),
+        "over_budget": over_budget,
+        "spec": spec.describe(),
+        "per_lane": lane_records,
+    }
+    tel.event("sweep_rollup", lanes=B, converged_lanes=int(done.sum()),
+              rounds_p50=q50, rounds_p95=q95)
+
+    return SweepResult(
+        converged=bool(done.all()),
+        rounds=cur_round,
+        wall_ms=wall_ms,
+        compile_ms=compile_ms,
+        num_nodes=n,
+        algorithm=cfg.algorithm,
+        final_state=final_state,
+        metrics=metrics,
+        lanes=B,
+        lane_records=lane_records,
+    )

@@ -600,3 +600,66 @@ def test_plan_cache_cli_second_run_skips_build(tmp_path, capsys,
     code, _, err = run_cli(argv[:-3] + ["--plan-cache", "none", "--quiet"],
                            capsys)
     assert code == 2 and "probe" in err
+
+
+# ---- sweep flag validation matrix (all exit 2, nothing compiled) --------
+
+
+def test_sweep_cli_bad_json_plan(tmp_path, capsys):
+    p = tmp_path / "plan.json"
+    p.write_text("{not json")
+    code, _, err = run_cli(
+        ["27", "imp3D", "push-sum", "--sweep", str(p)], capsys)
+    assert code == 2 and "not valid JSON" in err
+
+
+def test_sweep_cli_structural_axis(tmp_path, capsys):
+    p = tmp_path / "plan.json"
+    p.write_text('{"axes": {"algorithm": ["gossip", "push-sum"]}}')
+    code, _, err = run_cli(
+        ["27", "imp3D", "push-sum", "--sweep", str(p)], capsys)
+    assert code == 2 and "structural axis" in err
+
+
+def test_sweep_cli_lane_floor(capsys):
+    # --sweep-seeds is _positive_int: argparse itself rejects 0 with
+    # usage + exit 2 before any config is built
+    with pytest.raises(SystemExit) as ei:
+        main(["27", "imp3D", "push-sum", "--sweep-seeds", "0"])
+    assert ei.value.code == 2
+    capsys.readouterr()
+
+
+def test_sweep_cli_flags_mutually_exclusive(tmp_path, capsys):
+    p = tmp_path / "plan.json"
+    p.write_text('{"axes": {"seed": [0, 1]}}')
+    code, _, err = run_cli(
+        ["27", "imp3D", "push-sum", "--sweep", str(p),
+         "--sweep-seeds", "2"], capsys)
+    assert code == 2 and "exactly one" in err
+
+
+def test_sweep_cli_resume_rejected(tmp_path, capsys):
+    code, _, err = run_cli(
+        ["27", "imp3D", "push-sum", "--sweep-seeds", "2",
+         "--resume", str(tmp_path)], capsys)
+    assert code == 2 and "cannot resume" in err
+
+
+def test_sweep_cli_over_capacity_names_lanes(monkeypatch, capsys):
+    """The refusal must say the sweep (not the base run) blew the
+    budget, and point at the lane knob."""
+    monkeypatch.setenv("GOSSIP_TPU_HBM_BYTES", "200000")
+    code, _, err = run_cli(
+        ["4096", "imp3D", "push-sum", "--sweep-seeds", "64"], capsys)
+    assert code == 2
+    assert "64-lane sweep" in err
+    assert "shrink the sweep" in err
+
+
+def test_sweep_cli_happy_path_summary(capsys):
+    code, out, _ = run_cli(
+        ["27", "imp3D", "push-sum", "--sweep-seeds", "2",
+         "--chunk-rounds", "32"], capsys)
+    assert code == 0
+    assert "sweep: 2 lanes, 2 converged" in out

@@ -1,0 +1,304 @@
+"""Mega-sweep tests (ROADMAP: vmapped multi-tenant lanes): spec
+validation, the per-lane bitwise contract against standalone runs
+(single chip and sharded), staggered per-lane freeze, the one-build
+plan contract, and capacity refusal with lane-aware pricing."""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.obs.capacity import CapacityError, preflight
+from gossipprotocol_tpu.parallel import make_mesh, run_simulation_sharded
+from gossipprotocol_tpu.sweep import SweepSpec
+from gossipprotocol_tpu.sweep.engine import SweepConfigError
+
+
+def _assert_lane_bitwise(res, lane, standalone):
+    """Lane ``lane`` of the sweep must be the standalone run, bitwise."""
+    lane_rec = res.lane_records[lane]
+    assert lane_rec["converged"] == standalone.converged
+    assert lane_rec["rounds"] == standalone.rounds
+    got = res.lane_state(lane)
+    want = standalone.final_state
+    for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        assert np.array_equal(np.asarray(g), np.asarray(w)), (
+            f"lane {lane} diverged from its standalone run"
+        )
+
+
+# ---- spec validation ----------------------------------------------------
+
+
+def test_spec_structural_axis_rejected():
+    with pytest.raises(ValueError, match="structural axis"):
+        SweepSpec(axes=(("algorithm", ("gossip", "push-sum")),))
+
+
+def test_spec_unknown_axis_rejected():
+    with pytest.raises(ValueError, match="unknown sweep axis"):
+        SweepSpec(axes=(("wibble", (1, 2)),))
+
+
+def test_spec_sgp_axes_deferred():
+    with pytest.raises(ValueError, match="SGP workloads are not sweepable"):
+        SweepSpec(axes=(("lr", (0.1, 0.2)),))
+
+
+def test_spec_duplicate_axis_rejected():
+    with pytest.raises(ValueError, match="declared twice"):
+        SweepSpec(axes=(("seed", (0,)), ("seed", (1,))))
+
+
+def test_spec_zip_needs_equal_lengths():
+    with pytest.raises(ValueError, match="zip"):
+        SweepSpec(axes=(("seed", (0, 1, 2)), ("eps", (1e-9,))), mode="zip")
+
+
+def test_spec_no_axes_rejected():
+    with pytest.raises(ValueError, match="declares no axes"):
+        SweepSpec(axes=())
+
+
+def test_spec_empty_values_rejected():
+    with pytest.raises(ValueError, match="non-empty list"):
+        SweepSpec(axes=(("seed", ()),))
+
+
+def test_spec_drop_prob_range():
+    with pytest.raises(ValueError, match="drop_prob"):
+        SweepSpec(axes=(("drop_prob", (0.0, 1.0)),))
+
+
+def test_spec_threshold_floor():
+    with pytest.raises(ValueError, match="threshold"):
+        SweepSpec(axes=(("threshold", (0,)),))
+
+
+def test_spec_eps_positive():
+    with pytest.raises(ValueError, match="eps"):
+        SweepSpec(axes=(("eps", (0.0,)),))
+
+
+def test_spec_from_seeds_floor():
+    with pytest.raises(ValueError, match="B >= 1"):
+        SweepSpec.from_seeds(0)
+
+
+def test_spec_from_plan_unknown_key():
+    with pytest.raises(ValueError, match="unknown key"):
+        SweepSpec.from_plan({"axes": {"seed": [0]}, "lanes": 4})
+
+
+def test_spec_from_file_bad_json(tmp_path):
+    p = tmp_path / "plan.json"
+    p.write_text("{not json")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        SweepSpec.from_file(str(p))
+
+
+def test_spec_from_file_missing(tmp_path):
+    with pytest.raises(ValueError, match="cannot read sweep plan"):
+        SweepSpec.from_file(str(tmp_path / "nope.json"))
+
+
+def test_spec_product_lane_order():
+    spec = SweepSpec(axes=(("seed", (0, 1)), ("eps", (1e-9, 1e-7))))
+    assert spec.lanes == 4
+    # last axis varies fastest (itertools.product order)
+    assert spec.lane_overrides(0) == {"seed": 0, "eps": 1e-9}
+    assert spec.lane_overrides(1) == {"seed": 0, "eps": 1e-7}
+    assert spec.lane_overrides(2) == {"seed": 1, "eps": 1e-9}
+
+
+def test_spec_zip_lane_order():
+    spec = SweepSpec(axes=(("seed", (3, 4)), ("eps", (1e-9, 1e-7))),
+                     mode="zip")
+    assert spec.lanes == 2
+    assert spec.lane_overrides(1) == {"seed": 4, "eps": 1e-7}
+
+
+def test_spec_lane_config_drop_prob_synthesizes_window():
+    spec = SweepSpec(axes=(("drop_prob", (0.0, 0.25)),))
+    cfg = spec.lane_config(RunConfig(algorithm="push-sum"), 1)
+    (window,) = cfg.schedule.loss
+    assert window.prob == 0.25
+
+
+def test_spec_lane_config_activation_rate_needs_poisson():
+    spec = SweepSpec(axes=(("activation_rate", (0.5, 1.0)),))
+    with pytest.raises(ValueError, match="poisson"):
+        spec.lane_config(RunConfig(algorithm="gossip"), 0)
+
+
+def test_spec_describe_roundtrips():
+    spec = SweepSpec.from_plan({"axes": {"seed": [0, 1]}, "mode": "product"})
+    doc = spec.describe()
+    assert doc == {"mode": "product", "lanes": 2, "axes": {"seed": [0, 1]}}
+    rebuilt = SweepSpec.from_plan(
+        json.loads(json.dumps({"axes": doc["axes"], "mode": doc["mode"]})))
+    assert rebuilt.lanes == 2 and rebuilt.lane_overrides(1) == {"seed": 1}
+
+
+# ---- single-chip bitwise contract ---------------------------------------
+
+
+def test_seed_sweep_pushsum_lanes_bitwise():
+    topo = build_topology("imp3D", 27, seed=2)
+    base = RunConfig(algorithm="push-sum", seed=0, chunk_rounds=32)
+    res = run_simulation(
+        topo, dataclasses.replace(base, sweep=SweepSpec.from_seeds(3)))
+    assert res.lanes == 3 and res.converged
+    for i in range(3):
+        solo = run_simulation(topo, dataclasses.replace(base, seed=i))
+        _assert_lane_bitwise(res, i, solo)
+
+
+def test_seed_sweep_gossip_lanes_bitwise():
+    topo = build_topology("imp3D", 27, seed=2)
+    base = RunConfig(algorithm="gossip", seed=0, chunk_rounds=32)
+    res = run_simulation(
+        topo, dataclasses.replace(base, sweep=SweepSpec.from_seeds(3)))
+    assert res.lanes == 3 and res.converged
+    for i in range(3):
+        solo = run_simulation(topo, dataclasses.replace(base, seed=i))
+        _assert_lane_bitwise(res, i, solo)
+
+
+def test_traced_eps_axis_staggered_freeze_bitwise():
+    """A loose-eps lane converges rounds before a tight-eps lane; the
+    early lane's carry must FREEZE bitwise at its own convergence round,
+    exactly where its standalone run stops."""
+    topo = build_topology("imp3D", 27, seed=2)
+    base = RunConfig(algorithm="push-sum", seed=4, chunk_rounds=32)
+    spec = SweepSpec(axes=(("eps", (1e-4, 1e-10)),))
+    res = run_simulation(topo, dataclasses.replace(base, sweep=spec))
+    assert res.converged
+    rounds = [lr["rounds"] for lr in res.lane_records]
+    assert rounds[0] < rounds[1], "eps axis should stagger convergence"
+    for i, eps in enumerate((1e-4, 1e-10)):
+        solo = run_simulation(topo, dataclasses.replace(base, eps=eps))
+        _assert_lane_bitwise(res, i, solo)
+
+
+def test_traced_threshold_axis_gossip_bitwise():
+    topo = build_topology("3D", 27)
+    base = RunConfig(algorithm="gossip", seed=9, chunk_rounds=32)
+    spec = SweepSpec(axes=(("threshold", (5, 10)),))
+    res = run_simulation(topo, dataclasses.replace(base, sweep=spec))
+    assert res.converged
+    for i, thr in enumerate((5, 10)):
+        solo = run_simulation(topo, dataclasses.replace(base, threshold=thr))
+        _assert_lane_bitwise(res, i, solo)
+
+
+def test_sweep_builds_delivery_tables_once(monkeypatch):
+    """The tentpole contract: B lanes share ONE topology build — the
+    delivery tables are structural, so the sweep must call
+    ``device_arrays`` exactly once regardless of lane count."""
+    import gossipprotocol_tpu.engine.driver as driver
+
+    calls = []
+    real = driver.device_arrays
+    monkeypatch.setattr(
+        driver, "device_arrays",
+        lambda *a, **k: calls.append(1) or real(*a, **k))
+    topo = build_topology("imp3D", 27, seed=2)
+    cfg = RunConfig(algorithm="push-sum", seed=0, chunk_rounds=32,
+                    sweep=SweepSpec.from_seeds(4))
+    res = run_simulation(topo, cfg)
+    assert res.converged and res.lanes == 4
+    assert len(calls) == 1, f"expected one shared build, saw {len(calls)}"
+
+
+def test_sweep_rejects_resume():
+    topo = build_topology("imp3D", 27, seed=2)
+    cfg = RunConfig(algorithm="gossip", sweep=SweepSpec.from_seeds(2))
+    with pytest.raises(ValueError, match="cannot resume"):
+        run_simulation(topo, cfg, initial_state=object())
+
+
+def test_sweep_envelope_rejects_sgp_workload():
+    topo = build_topology("imp3D", 27, seed=2)
+    cfg = RunConfig(algorithm="push-sum", workload="sgp",
+                    predicate="global", sweep=SweepSpec.from_seeds(2))
+    with pytest.raises(SweepConfigError):
+        run_simulation(topo, cfg)
+
+
+def test_sweep_envelope_rejects_accel():
+    topo = build_topology("imp3D", 27, seed=2)
+    cfg = RunConfig(algorithm="push-sum", fanout="all", accel="epd",
+                    sweep=SweepSpec.from_seeds(2))
+    with pytest.raises(SweepConfigError):
+        run_simulation(topo, cfg)
+
+
+# ---- capacity: lanes multiply per-run state -----------------------------
+
+
+def test_capacity_prices_lanes_and_refuses(monkeypatch):
+    topo = build_topology("imp3D", 512, seed=0)
+    base = RunConfig(algorithm="push-sum", chunk_rounds=32)
+    from gossipprotocol_tpu.obs.capacity import estimate_for_topology
+
+    one = estimate_for_topology(topo, base, 1)["per_device"]["total_bytes"]
+    # enough room for one run (2x headroom), nowhere near enough for 64
+    monkeypatch.setenv("GOSSIP_TPU_HBM_BYTES", str(int(one * 2)))
+    preflight(topo, base, 1)  # one run fits — must not raise
+    sweep_cfg = dataclasses.replace(base, sweep=SweepSpec.from_seeds(64))
+    est = estimate_for_topology(topo, sweep_cfg, 1)
+    assert est["lanes"] == 64
+    assert est["per_device"]["total_bytes"] > one * 16
+    with pytest.raises(CapacityError) as ei:
+        preflight(topo, sweep_cfg, 1)
+    msg = str(ei.value)
+    assert "64-lane sweep" in msg
+    assert "shrink the sweep" in msg
+
+
+# ---- sharded sweeps (vmap outside shard_map) ----------------------------
+
+
+def test_sharded_sweep_rejects_traced_axes(cpu_devices):
+    topo = build_topology("imp3D", 64, seed=2)
+    cfg = RunConfig(algorithm="push-sum", chunk_rounds=32,
+                    sweep=SweepSpec(axes=(("eps", (1e-8, 1e-10)),)))
+    with pytest.raises(SweepConfigError, match="host"):
+        run_simulation_sharded(topo, cfg,
+                               mesh=make_mesh(devices=cpu_devices[:2]))
+
+
+@pytest.mark.parametrize("shards", [2, 4, 8])
+def test_sharded_seed_sweep_pushsum_bitwise(cpu_devices, shards):
+    """Lane i of the sharded sweep must equal the standalone SHARDED
+    run on the same mesh, bitwise — vmap composed outside shard_map
+    keeps the per-shard program and collective order unchanged."""
+    topo = build_topology("imp3D", 64, seed=3)
+    base = RunConfig(algorithm="push-sum", seed=0, chunk_rounds=32)
+    mesh = make_mesh(devices=cpu_devices[:shards])
+    res = run_simulation_sharded(
+        topo, dataclasses.replace(base, sweep=SweepSpec.from_seeds(2)),
+        mesh=mesh)
+    assert res.converged and res.lanes == 2
+    for i in range(2):
+        solo = run_simulation_sharded(
+            topo, dataclasses.replace(base, seed=i), mesh=mesh)
+        _assert_lane_bitwise(res, i, solo)
+
+
+def test_sharded_seed_sweep_gossip_bitwise(cpu_devices):
+    topo = build_topology("imp3D", 64, seed=3)
+    base = RunConfig(algorithm="gossip", seed=0, chunk_rounds=32)
+    mesh = make_mesh(devices=cpu_devices[:4])
+    res = run_simulation_sharded(
+        topo, dataclasses.replace(base, sweep=SweepSpec.from_seeds(2)),
+        mesh=mesh)
+    assert res.converged and res.lanes == 2
+    for i in range(2):
+        solo = run_simulation_sharded(
+            topo, dataclasses.replace(base, seed=i), mesh=mesh)
+        _assert_lane_bitwise(res, i, solo)
