@@ -246,6 +246,127 @@ def _delivery_microbench() -> None:
     }))
 
 
+def _build_microbench() -> None:
+    """``BENCH_BUILD_ONLY=1``: topology *construction* time + peak host
+    RSS, streamed (per-shard CSR slices, ``topology/stream.py``) vs
+    materialized (global edge list + global CSR), over a node curve.
+
+    No simulation runs — this measures the out-of-core build contract:
+    streamed peak RSS is O(E/shards + budget) while materialized is
+    O(E). Each row runs in a **subprocess** so VmHWM is per-row, not the
+    max over the whole curve. Materialized rows whose closed-form build
+    estimate exceeds the RSS ceiling are skipped with a stamped reason
+    (the estimate then stands in for the comparison). The digest oracle
+    runs wherever both builds exist: a streamed build that is not
+    byte-identical to the materialized one must not produce a datapoint.
+
+    Knobs: ``BENCH_BUILD_NODES`` (comma list, default
+    ``1000000,10000000,100000000``), ``BENCH_BUILD_TOPOLOGY`` (default
+    ``erdos_renyi``), ``BENCH_BUILD_SHARDS`` (default 8),
+    ``BENCH_BUILD_BUDGET`` (spill budget, default ``512M``),
+    ``BENCH_BUILD_RSS_CEILING`` (bytes; default 80% of MemAvailable).
+    """
+    import subprocess
+
+    from gossipprotocol_tpu.obs.capacity import estimate_build_host_bytes
+    from gossipprotocol_tpu.topology.stream import parse_byte_size
+
+    topology = os.environ.get("BENCH_BUILD_TOPOLOGY", "erdos_renyi")
+    shards = int(os.environ.get("BENCH_BUILD_SHARDS", 8))
+    budget = os.environ.get("BENCH_BUILD_BUDGET", "512M")
+    nodes = [int(s) for s in os.environ.get(
+        "BENCH_BUILD_NODES", "1000000,10000000,100000000").split(",")]
+
+    ceiling_env = os.environ.get("BENCH_BUILD_RSS_CEILING")
+    if ceiling_env:
+        ceiling = parse_byte_size(ceiling_env)
+        ceiling_src = "$BENCH_BUILD_RSS_CEILING"
+    else:
+        avail = None
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1]) * 1024
+                        break
+        except OSError:
+            pass
+        ceiling = int(avail * 0.8) if avail else 32 * 2 ** 30
+        ceiling_src = ("80% of MemAvailable" if avail
+                       else "32 GiB fallback")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def row_subprocess(code):
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env=env, timeout=7200)
+        if proc.returncode != 0:
+            return {"error": (proc.stderr or proc.stdout).strip()[-300:]}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    rows = []
+    for n in nodes:
+        row = {"num_nodes": n}
+        t0 = time.perf_counter()
+        streamed = row_subprocess(
+            "from gossipprotocol_tpu.topology.stream import main;"
+            f"import sys; sys.exit(main(['{topology}', '{n}', "
+            f"'--shards', '{shards}', '--build-memory-budget', "
+            f"'{budget}', '--json']))")
+        streamed["wall_s"] = round(time.perf_counter() - t0, 2)
+        row["streamed"] = streamed
+
+        mat_est = estimate_build_host_bytes(topology, n)
+        row["materialized_estimate_bytes"] = mat_est
+        if mat_est > ceiling:
+            row["materialized"] = {
+                "skipped": (f"estimated build RSS {mat_est} bytes "
+                            f"exceeds ceiling {ceiling} ({ceiling_src})"),
+            }
+        else:
+            t0 = time.perf_counter()
+            mat = row_subprocess(
+                "import json;"
+                "from gossipprotocol_tpu.topology import build_topology;"
+                "from gossipprotocol_tpu.ops.plancache import cache_key;"
+                "from gossipprotocol_tpu.obs.resources import "
+                "host_peak_rss_bytes;"
+                "import time; t0=time.perf_counter();"
+                f"topo = build_topology('{topology}', {n}, seed=0);"
+                "print(json.dumps({'build_s': round("
+                "time.perf_counter()-t0, 3), 'digest': cache_key(topo),"
+                "'directed_edges': int(topo.num_directed_edges),"
+                "'peak_rss_bytes': host_peak_rss_bytes()}))")
+            mat["wall_s"] = round(time.perf_counter() - t0, 2)
+            row["materialized"] = mat
+            # correctness oracle before any RSS claim
+            if "digest" in streamed and "digest" in mat:
+                assert streamed["digest"] == mat["digest"], (
+                    f"digest mismatch at n={n}: streamed "
+                    f"{streamed['digest']} != materialized "
+                    f"{mat['digest']}")
+                row["digest_equal"] = True
+            mat_peak = mat.get("peak_rss_bytes")
+            if mat_peak and streamed.get("peak_rss_bytes"):
+                row["rss_ratio"] = round(
+                    streamed["peak_rss_bytes"] / mat_peak, 3)
+        if "peak_rss_bytes" in streamed:
+            row["rss_ratio_vs_estimate"] = round(
+                streamed["peak_rss_bytes"] / mat_est, 3)
+        rows.append(row)
+
+    print(json.dumps({
+        "metric": "topology_build_rss",
+        "topology": topology,
+        "num_shards": shards,
+        "build_memory_budget": budget,
+        "rss_ceiling_bytes": ceiling,
+        "rss_ceiling_source": ceiling_src,
+        "rows": rows,
+    }))
+
+
 def _sweep_microbench() -> None:
     """``BENCH_SWEEP_LANES=B``: batched-sweep throughput vs serial runs.
 
@@ -323,6 +444,11 @@ def _sweep_microbench() -> None:
 
 
 def main():
+    if os.environ.get("BENCH_BUILD_ONLY", "0") == "1":
+        # pure host-side construction — no accelerator probe needed
+        _build_microbench()
+        return
+
     probe_attempts = _probe_backend()
 
     if os.environ.get("BENCH_DELIVERY_ONLY", "0") == "1":

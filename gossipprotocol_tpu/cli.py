@@ -197,6 +197,23 @@ def _build_run_topology(args):
 
     name = canonical_name(args.topology)
     ref = args.semantics == "reference"
+
+    build = getattr(args, "build", "auto")
+    budget = None
+    if getattr(args, "build_memory_budget", None) is not None:
+        from gossipprotocol_tpu.topology.stream import parse_byte_size
+
+        budget = parse_byte_size(args.build_memory_budget)
+    if build == "streamed" or (build == "auto" and budget is not None):
+        if ref:
+            if build == "streamed":
+                raise ValueError(
+                    "--build streamed renders the intended-mode graph "
+                    "only; the reference-mode population quirks "
+                    "(--semantics reference) need the materialized "
+                    "builders")
+        else:
+            return _build_streamed_topology(args, build, budget), None
     if ref and name in ("line", "full"):
         topo = build_topology(name, args.num_nodes + 1)
         return topo, args.num_nodes
@@ -213,6 +230,47 @@ def _build_run_topology(args):
         k=args.ws_k, beta=args.ws_beta,
     )
     return topo, None
+
+
+def _build_streamed_topology(args, build, budget):
+    """The out-of-core construction path behind ``--build streamed`` /
+    ``--build auto --build-memory-budget``.
+
+    With ``--devices > 1`` on a slice-consuming run configuration the
+    build lands a :class:`~gossipprotocol_tpu.topology.stream.\
+ShardedTopology` — per-shard CSR slices, peak host RSS O(E/shards +
+    budget), byte-identical slices and adjacency digest to the
+    materialized build. Everywhere else the edges still stream through
+    the bounded spill build, but the final CSR is materialized (the
+    single-chip engine needs the global adjacency).
+    """
+    from gossipprotocol_tpu.topology import stream
+
+    es = stream.edge_stream(
+        args.topology, args.num_nodes,
+        seed=args.seed, avg_degree=args.avg_degree, m=args.attach,
+        k=args.ws_k, beta=args.ws_beta,
+    )
+    devices = getattr(args, "devices", None)
+    sharded = devices is not None and devices > 1
+    if sharded and build == "auto":
+        # auto only picks the sharded slice form when this run can
+        # actually consume it (sharded routed push-sum, no event/repair
+        # rewrites); --build streamed skips the check and lets the
+        # engine reject incompatible configs loudly
+        algo = _ALGO_ALIASES.get(args.algorithm.lower(), args.algorithm)
+        sharded = (
+            algo != "gossip" and args.fanout == "all"
+            and args.delivery in ("routed", "pallas")
+            and args.repair == "off"
+            and args.event_plan is None and args.churn is None
+        )
+    if sharded:
+        return stream.build_sharded_topology(
+            es, devices, memory_budget=budget,
+            build_workers=args.build_workers,
+        )
+    return stream.topology_from_stream(es, memory_budget=budget)
 
 
 def resume_argv(argv, checkpoint_dir, attempts_left):
@@ -385,6 +443,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "fixpoint; plans are bitwise-identical for every "
                         "N, so this only trades build wall-time. 1 forces "
                         "the serial builder")
+    p.add_argument("--build", choices=["auto", "materialized", "streamed"],
+                   default="auto", metavar="MODE",
+                   help="topology construction strategy: 'materialized' "
+                        "(the classic global edge list + global CSR), "
+                        "'streamed' (out-of-core: generators emit bounded "
+                        "edge chunks and the build lands per-shard CSR "
+                        "slices directly — peak host RSS O(E/shards) "
+                        "instead of O(E); sharded routed designs only), "
+                        "or 'auto' (default: materialized, switching to "
+                        "streamed when --build-memory-budget is set and "
+                        "the run is sharded-routed-compatible). Streamed "
+                        "and materialized builds are byte-identical per "
+                        "shard and share the adjacency digest, so plan "
+                        "caches hit across strategies")
+    p.add_argument("--build-memory-budget", type=str, default=None,
+                   metavar="BYTES",
+                   help="host-memory budget for the streamed build's "
+                        "spill buffers (supports K/M/G suffixes, e.g. "
+                        "512M). Buffered edge pairs past the budget spill "
+                        "to per-shard temp files and are merged at "
+                        "finalize. Implies --build streamed under "
+                        "--build auto")
     p.add_argument("--value-mode", choices=["scaled", "index"], default="scaled",
                    help="push-sum init: i/N (TPU-safe) or the reference's s_i=i")
     p.add_argument("--payload-dim", type=_positive_int, default=1,

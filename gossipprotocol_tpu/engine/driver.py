@@ -1216,6 +1216,14 @@ def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
     pytree — same slot, so the chunk runner and ``shard_map`` specs treat
     data rows exactly like neighbor rows.
     """
+    if hasattr(topo, "csr_slice"):
+        # streamed builds carry per-shard CSR slices, never the global
+        # adjacency this pytree is assembled from — the slices are only
+        # consumable on the sharded routed designs (--devices > 1)
+        raise ValueError(
+            "a streamed topology build has no global adjacency for the "
+            "single-chip engine — run with --devices > 1 (sharded routed "
+            "push-sum) or use --build materialized")
     if cfg.algorithm == "push-sum" and cfg.workload in ("sgp", "gala"):
         from gossipprotocol_tpu.learn import SGPBundle, make_least_squares
 
@@ -1636,7 +1644,7 @@ def _drive(
     run_topo = run_topo if run_topo is not None else topo
     chunk_rounds = cfg.resolve_chunk_rounds(
         topo.num_nodes,
-        None if topo.implicit_full else int(topo.indices.size),
+        None if topo.implicit_full else int(topo.num_directed_edges),
     )
     metrics: List[dict] = []
     checkpoints: List[str] = []
@@ -1920,7 +1928,7 @@ def run_simulation(
     # row per round of the largest possible chunk)
     counter_slots = cfg.resolve_chunk_rounds(
         topo.num_nodes,
-        None if topo.implicit_full else int(topo.indices.size),
+        None if topo.implicit_full else int(topo.num_directed_edges),
     )
 
     def engine_counter_fn(ctopo, aa, ta):

@@ -45,7 +45,9 @@ from typing import Any, Dict, Optional, Tuple
 
 __all__ = ["CapacityError", "edges_estimate", "estimate_run_bytes",
            "estimate_for_topology", "device_capacity_bytes",
-           "max_feasible_nodes", "preflight", "main"]
+           "max_feasible_nodes", "estimate_build_host_bytes",
+           "suggest_build_shards", "build_rss_budget_bytes",
+           "preflight", "main"]
 
 
 class CapacityError(ValueError):
@@ -421,6 +423,89 @@ def estimate_for_topology(topo, cfg, num_devices: int = 1,
     )
 
 
+def estimate_build_host_bytes(
+    kind: str,
+    num_nodes: int,
+    num_shards: int = 1,
+    *,
+    streamed: bool = False,
+    memory_budget: Optional[int] = None,
+    store_on_disk: bool = False,
+    chunk_edges: Optional[int] = None,
+    avg_degree: float = 8.0,
+    m: int = 4,
+    k: int = 6,
+) -> int:
+    """Predicted peak *host* RSS of topology construction, closed-form —
+    the build-time twin of :func:`estimate_run_bytes` (which prices the
+    run, after the build already survived).
+
+    Materialized (``topology/builders.py`` + ``csr_from_edges``): the
+    global undirected edge list, its symmetrized int64 src/dst pair, the
+    dedup sort key, and the final CSR are all simultaneously live —
+    ~40 bytes per directed edge plus ~16 per node.
+
+    Streamed (``topology/stream.py``): one shard's pair set plus its
+    finalize workspace (~24 bytes per *per-shard* directed edge), the
+    bounded generator chunk, the spill buffer (``memory_budget``), and —
+    unless slices go to ``store_dir`` files — the finished int32 slices
+    (4 B/edge). Power-law adds its frozen endpoint list (4 B/edge); it
+    is the one generator whose replay state is O(E).
+    """
+    from gossipprotocol_tpu.topology.registry import canonical_name
+
+    n = int(num_nodes)
+    e_dir, _ = edges_estimate(kind, n, avg_degree=avg_degree, m=m, k=k)
+    if not streamed:
+        return 40 * e_dir + 16 * n
+    s = max(1, int(num_shards))
+    if chunk_edges is None:
+        from gossipprotocol_tpu.topology.stream import DEFAULT_CHUNK_EDGES
+
+        chunk_edges = DEFAULT_CHUNK_EDGES
+    if memory_budget is None:
+        from gossipprotocol_tpu.topology.stream import DEFAULT_SPILL_BUDGET
+
+        memory_budget = DEFAULT_SPILL_BUDGET
+    total = 24 * (e_dir // s) + 32 * int(chunk_edges) + int(memory_budget)
+    if not store_on_disk:
+        total += 4 * e_dir + 8 * n  # finished slices stay resident
+    if canonical_name(kind) == "power_law":
+        total += 4 * e_dir
+    return total
+
+
+def build_rss_budget_bytes() -> Optional[int]:
+    """``$GOSSIP_TPU_BUILD_RSS_BYTES`` — the host-memory admission budget
+    for topology construction (None when unset)."""
+    env = os.environ.get("GOSSIP_TPU_BUILD_RSS_BYTES")
+    if not env:
+        return None
+    try:
+        from gossipprotocol_tpu.topology.stream import parse_byte_size
+
+        return parse_byte_size(env)
+    except ValueError:
+        raise CapacityError(
+            f"bad $GOSSIP_TPU_BUILD_RSS_BYTES {env!r} (want bytes, "
+            "K/M/G suffixes ok)")
+
+
+def suggest_build_shards(kind: str, num_nodes: int, budget: int,
+                         max_shards: int = 4096, **topo_params) -> Optional[int]:
+    """Smallest power-of-two shard count whose *streamed* build estimate
+    fits ``budget`` host bytes — the shard-count knob driven by build
+    memory rather than HBM. None when even ``max_shards`` won't fit
+    (the per-chunk and resident-slice floors are shard-independent)."""
+    s = 1
+    while s <= max_shards:
+        if estimate_build_host_bytes(
+                kind, num_nodes, s, streamed=True, **topo_params) <= budget:
+            return s
+        s *= 2
+    return None
+
+
 def device_capacity_bytes() -> Tuple[Optional[int], str]:
     """(per-device byte capacity, source). ``$GOSSIP_TPU_HBM_BYTES``
     wins (explicit admission-control budget); else the first device's
@@ -477,6 +562,31 @@ def preflight(topo, cfg, num_devices: int = 1, tel=None) -> Optional[Dict[str, A
     known, None when it is not (CPU without the env override). Raises
     :class:`CapacityError` when the prediction exceeds the safety budget.
     """
+    build_budget = build_rss_budget_bytes()
+    if build_budget is not None and not topo.implicit_full:
+        # the materialized build this topology would need (exact edge
+        # count — the graph exists by now): warn when it exceeds the
+        # host budget, so the operator learns the streamed build exists
+        # before the next-size-up run OOMs the host
+        e_dir = int(topo.num_directed_edges)
+        mat = 40 * e_dir + 16 * int(topo.num_nodes)
+        if mat > build_budget and not hasattr(topo, "csr_slice"):
+            streamed_est = estimate_build_host_bytes(
+                topo.kind, topo.num_nodes, max(1, int(num_devices)),
+                streamed=True)
+            msg = (
+                f"host-build warning: a materialized {topo.kind}-"
+                f"{topo.num_nodes} build peaks at ~{_fmt(mat)} host RSS, "
+                f"over $GOSSIP_TPU_BUILD_RSS_BYTES={_fmt(build_budget)} "
+                f"(streamed build would need ~{_fmt(streamed_est)}; use "
+                f"--build streamed / --build-memory-budget)")
+            print(msg, file=sys.stderr)
+            if tel is not None:
+                tel.note_resource("build_rss_warning", {
+                    "materialized_bytes": mat,
+                    "streamed_bytes": int(streamed_est),
+                    "budget_bytes": int(build_budget),
+                })
     capacity, source = device_capacity_bytes()
     if capacity is None:
         return None
@@ -621,6 +731,15 @@ def main(argv=None) -> int:
         doc["capacity_bytes"] = capacity
         doc["capacity_source"] = source
         doc["safety"] = args.safety
+        if doc["kind"] != "full":
+            doc["build_host_bytes"] = {
+                "materialized": estimate_build_host_bytes(
+                    args.topology, args.num_nodes,
+                    avg_degree=args.avg_degree),
+                "streamed": estimate_build_host_bytes(
+                    args.topology, args.num_nodes, args.devices,
+                    streamed=True, avg_degree=args.avg_degree),
+            }
         if capacity is not None:
             doc["capacity_fraction"] = round(total / capacity, 4)
             doc["max_feasible_nodes"] = max_feasible_nodes(
@@ -658,6 +777,15 @@ def main(argv=None) -> int:
                   f"be {_fmt(per['f32_exchange_bytes_per_round'])})")
         print(f"  total:        {_fmt(per['total_bytes']):>12}/device"
               f"  (argument bytes {_fmt(doc['argument_bytes'])})")
+        if doc["kind"] != "full":
+            mat_b = estimate_build_host_bytes(
+                args.topology, args.num_nodes, avg_degree=args.avg_degree)
+            str_b = estimate_build_host_bytes(
+                args.topology, args.num_nodes, args.devices, streamed=True,
+                avg_degree=args.avg_degree)
+            print(f"  host build:   {_fmt(str_b):>12} streamed "
+                  f"({args.devices} shard(s)) vs {_fmt(mat_b)} "
+                  f"materialized")
 
     if capacity is None:
         print("  capacity:     unknown (no device memory accounting on "

@@ -59,7 +59,7 @@ def _num_edges(topo) -> int:
         # K_n is handled analytically (estimate_gamma returns 0.0) — the
         # cap gate should never refuse it
         return 0
-    return int(topo.indices.size)
+    return int(topo.num_directed_edges)
 
 
 def _estimate_gamma(topo, cfg) -> float:
@@ -150,5 +150,11 @@ def maybe_predict_rounds(topo, cfg, required: bool = False
     heuristic needs no spectra, so the cap never gates it."""
     if (not required and cfg.algorithm != "gossip"
             and _num_edges(topo) > predict_edge_cap()):
+        return None
+    if (cfg.algorithm != "gossip" and cfg.accel_lambda is None
+            and hasattr(topo, "csr_slice")):
+        # a streamed build has no global CSR for the host power
+        # iteration; γ is only available when the user supplies the
+        # spectral bound (--accel-lambda)
         return None
     return predict_rounds(topo, cfg)

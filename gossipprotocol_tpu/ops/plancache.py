@@ -98,6 +98,12 @@ def cache_key(topo) -> str:
     collision-resistant digest. blake2b streams at GB/s; even the 100M
     CSR (~4 GB) keys in seconds against hours of build.
     """
+    digest = getattr(topo, "adjacency_digest", None)
+    if digest is not None:
+        # Topology hashes its global CSR; a streamed ShardedTopology
+        # reproduces the identical digest from per-shard slices — cache
+        # entries are shared across build paths by construction
+        return digest()
     h = hashlib.blake2b(digest_size=16)
     h.update(str(topo.num_nodes).encode())
     h.update(np.ascontiguousarray(topo.offsets))

@@ -127,6 +127,37 @@ def _register():
 _register()
 
 
+def shard_csr_slice(topo, lo: int, hi_real: int):
+    """``(degree int64[hi_real-lo], neighbors int64[nnz])`` of CSR rows
+    ``[lo, hi_real)``.
+
+    The ONE accessor through which every shard builder below touches the
+    adjacency: a materialized :class:`Topology` serves it by slicing its
+    global CSR; a streamed ``topology.stream.ShardedTopology`` serves it
+    from the per-shard slice it built out-of-core — so the routed plan
+    builds never require the global edge list to exist.
+    """
+    if hi_real <= lo:  # a fully-padded shard owns no real rows
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    fn = getattr(topo, "csr_slice", None)
+    if fn is not None:
+        return fn(lo, hi_real)
+    offsets = np.asarray(topo.offsets, np.int64)
+    deg = np.diff(offsets[lo:hi_real + 1])
+    nbr = np.asarray(topo.indices[offsets[lo]: offsets[hi_real]],
+                     np.int64)
+    return deg, nbr
+
+
+def _row_starts(deg: np.ndarray) -> np.ndarray:
+    """Local CSR row starts (``offsets[lo:hi] - offsets[lo]``) from the
+    slice's degree vector alone."""
+    starts = np.zeros(len(deg), np.int64)
+    if len(deg) > 1:
+        np.cumsum(deg[:-1], out=starts[1:])
+    return starts
+
+
 def build_shard_delivery(
     topo: Topology, lo: int, hi: int,
     caps_src: dict | None = None, caps_tgt: dict | None = None,
@@ -164,16 +195,14 @@ def build_shard_delivery(
     n = topo.num_nodes
     local_n = hi - lo
     hi_real = min(hi, n)
-    offsets = np.asarray(topo.offsets, np.int64)
-    indices = np.asarray(topo.indices, np.int64)
-    degree_full = np.diff(offsets)
+    deg_slice, src = shard_csr_slice(topo, lo, hi_real)
     # local in-degree, zero on padding rows past n
     degree = np.zeros(local_n, np.int64)
-    degree[: hi_real - lo] = degree_full[lo:hi_real]
+    degree[: hi_real - lo] = deg_slice
 
     # the directed restriction, enumerated by target row (CSR order):
     # edge k has target tgt[k] in [lo, hi_real) and source src[k] anywhere
-    src = indices[offsets[lo]: offsets[hi_real]]
+    # (src is the shard's CSR index slice)
 
     if need_src:
         # ---- expand side: sources classed by out-degree INTO the shard
@@ -186,10 +215,9 @@ def build_shard_delivery(
 
     if "m" in groups:
         tgt = np.repeat(np.arange(lo, hi_real, dtype=np.int64),
-                        degree_full[lo:hi_real])
+                        deg_slice)
         in_rank = (np.arange(len(src), dtype=np.int64)
-                   - np.repeat(offsets[lo:hi_real] - offsets[lo],
-                               degree_full[lo:hi_real]))
+                   - np.repeat(_row_starts(deg_slice), deg_slice))
         # out-rank of each directed edge within its source's edge group
         from gossipprotocol_tpu.ops.plan import argsort_pairs
 
@@ -205,7 +233,7 @@ def build_shard_delivery(
     if need_tgt:
         # ---- reduce side: targets classed by their full degree -------
         cls_tgt_full = np.zeros(n, np.int64)
-        cls_tgt_full[lo:hi_real] = degree_classes(degree_full[lo:hi_real])
+        cls_tgt_full[lo:hi_real] = degree_classes(deg_slice)
         order_t, rank_t, _ = class_order(cls_tgt_full, n)
         classes_tgt, start_tgt, m_pairs_tgt, pos_t = class_layout(
             cls_tgt_full[order_t], caps=caps_tgt)
@@ -277,18 +305,15 @@ def _shard_class_counts(topo: Topology, bounds):
     """Per-shard (src, tgt) class counts, plans untouched — the cheap
     pre-pass that finds the cross-shard capacity maxima."""
     n = topo.num_nodes
-    offsets = np.asarray(topo.offsets, np.int64)
-    indices = np.asarray(topo.indices, np.int64)
-    degree_full = np.diff(offsets)
     caps_src: dict = {}
     caps_tgt: dict = {}
     for lo, hi in zip(bounds[:-1], bounds[1:]):
         hi_real = min(hi, n)
-        src = indices[offsets[lo]: offsets[hi_real]]
+        deg_slice, src = shard_csr_slice(topo, lo, hi_real)
         out_deg = np.bincount(src, minlength=n)
         for cls_vec, caps in (
             (degree_classes(out_deg), caps_src),
-            (degree_classes(degree_full[lo:hi_real]), caps_tgt),
+            (degree_classes(deg_slice), caps_tgt),
         ):
             c_vals, counts = np.unique(cls_vec[cls_vec > 0],
                                        return_counts=True)
@@ -339,6 +364,15 @@ def _shard_build_task(task, progress=None):
     (reading the fork snapshot) and inline for the serial path."""
     mode, k, groups, cr_floors = task
     st = _WORKER_STATE
+    if st["kind"] == "stream":
+        # streamed topology build: shard k independently replays the
+        # deterministic edge generator and keeps only its own rows
+        # (topology/stream.py two-pass mode) — same pool, same
+        # worker-count-independence contract as the plan builds
+        from gossipprotocol_tpu.topology.stream import _build_stream_shard
+
+        return _build_stream_shard(st["stream"], st["bounds"], k,
+                                   st["store_dir"])
     if st["kind"] == "pull":
         bounds = st["bounds"]
         return build_shard_delivery(
@@ -758,11 +792,9 @@ def build_shard_push_delivery(
     local = n_padded // num_shards
     lo = shard * local
     hi_real = max(lo, min(lo + local, n))
-    offsets = np.asarray(topo.offsets, np.int64)
-    indices = np.asarray(topo.indices, np.int64)
-    degree_full = np.diff(offsets)
+    deg_slice, nbr_slice = shard_csr_slice(topo, lo, hi_real)
     degree = np.zeros(local, np.int64)
-    degree[: hi_real - lo] = degree_full[lo:hi_real]
+    degree[: hi_real - lo] = deg_slice
 
     # one class set for both sides (see the design note above)
     cls = degree_classes(degree)
@@ -775,12 +807,11 @@ def build_shard_push_delivery(
         # the shard's CSR slice: entry j = (row[j], nbr[j]); slot[j] is
         # BOTH the e1 slot of out-edge row->nbr and the f slot of
         # in-edge nbr->row, because the two sides share one layout
-        nbr = indices[offsets[lo]: offsets[hi_real]]
+        nbr = nbr_slice
         row = np.repeat(np.arange(lo, hi_real, dtype=np.int64),
-                        degree_full[lo:hi_real])
+                        deg_slice)
         pos_in_row = (np.arange(len(nbr), dtype=np.int64)
-                      - np.repeat(offsets[lo:hi_real] - offsets[lo],
-                                  degree_full[lo:hi_real]))
+                      - np.repeat(_row_starts(deg_slice), deg_slice))
         slot = node_start_pair[rank[row - lo]] + pos_in_row
         nbr_shard = nbr // local
         is_local = nbr_shard == shard
@@ -968,21 +999,17 @@ def _build_push_shards(topo: Topology, n_padded: int, num_shards: int,
     # capacity + block pre-pass: per-class node-count maxima and the
     # cross-shard max block census (one bincount per shard, O(E) total)
     n = topo.num_nodes
-    offsets = np.asarray(topo.offsets, np.int64)
-    indices = np.asarray(topo.indices, np.int64)
-    degree_full = np.diff(offsets)
     caps: dict = {}
     bmax = 0
     e_max = 0
     for k in range(num_shards):
         lo = k * local
         hi_real = max(lo, min(lo + local, n))
-        deg = degree_full[lo:hi_real]
+        deg, nbr = shard_csr_slice(topo, lo, hi_real)
         cls = degree_classes(deg)
         c_vals, counts = np.unique(cls[cls > 0], return_counts=True)
         for c, cnt in zip(c_vals, counts):
             caps[int(c)] = max(caps.get(int(c), 0), int(cnt))
-        nbr = indices[offsets[lo]: offsets[hi_real]]
         e_max = max(e_max, len(nbr))
         nbr_shard = nbr // local
         cross = nbr_shard[nbr_shard != k]

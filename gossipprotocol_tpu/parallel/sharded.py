@@ -367,6 +367,27 @@ def make_sharded_chunk_runner(
     is_pushsum = cfg.algorithm != "gossip"
     routed = (is_pushsum and cfg.fanout == "all"
               and cfg.delivery in ("routed", "pallas"))
+    if hasattr(topo, "csr_slice"):
+        # a streamed out-of-core build carries per-shard CSR slices only;
+        # the global adjacency never exists. The routed plan builders
+        # consume slices natively — every other delivery assembles global
+        # edge/neighbor tables, so reject with the fix here instead of
+        # an AttributeError deep in table assembly.
+        if not routed:
+            raise ValueError(
+                "a streamed topology build (per-shard CSR slices, no "
+                "global adjacency) supports the sharded routed designs "
+                "only (push-sum, --fanout all, --delivery routed/pallas)"
+                " — use --build materialized for this config")
+        if topo.num_shards != num_shards:
+            raise ValueError(
+                f"streamed build is partitioned for {topo.num_shards} "
+                f"shards but the mesh has {num_shards} devices — "
+                "rebuild with a matching --devices")
+        if topo.n_padded != n_padded:
+            raise ValueError(
+                f"streamed build padded rows to {topo.n_padded}, the "
+                f"mesh wants {n_padded} — partition mismatch")
     psum_all = lambda x: jax.lax.psum(jnp.sum(x, axis=0), NODES_AXIS)  # noqa: E731
     counter_fn = None
     if tel.counters_on:
@@ -395,7 +416,7 @@ def make_sharded_chunk_runner(
     if (counter_fn is not None or trace_fn is not None) \
             and counter_slots is None:
         counter_slots = cfg.resolve_chunk_rounds(
-            n, None if topo.implicit_full else int(topo.indices.size)
+            n, None if topo.implicit_full else int(topo.num_directed_edges)
         )
 
     def chunk_local(state_l, nbrs, seed, round_limit):
@@ -846,6 +867,24 @@ def run_simulation_sharded(
     num_shards = int(mesh.devices.size)
     n_padded = padded_size(n, num_shards)
 
+    if hasattr(topo, "csr_slice"):
+        if cfg.repair != "off" or cfg.events.has_events:
+            # the event/repair engine rewrites the *global* adjacency
+            # (replay_topology, plan patching), which a streamed build
+            # never materializes; delivery compatibility itself is
+            # checked in make_sharded_chunk_runner
+            raise ValueError(
+                "event/repair schedules rewrite the global adjacency, "
+                "which a streamed build never materializes — use "
+                "--build materialized with event plans")
+        if topo.num_shards != num_shards:
+            # checked before the routed-push plan pre-build below, which
+            # would otherwise fail on a misaligned csr_slice request
+            raise ValueError(
+                f"streamed build is partitioned for {topo.num_shards} "
+                f"shards but the mesh has {num_shards} devices — "
+                "rebuild with a matching --devices")
+
     from gossipprotocol_tpu.engine.driver import resume_allows_fast
 
     run_topo = topo
@@ -867,7 +906,7 @@ def run_simulation_sharded(
     # counter-buffer rows must cover _drive's chunk sizing, which is
     # computed from the BIRTH topology (run_topo may be a repair replay)
     counter_slots = cfg.resolve_chunk_rounds(
-        n, None if topo.implicit_full else int(topo.indices.size)
+        n, None if topo.implicit_full else int(topo.num_directed_edges)
     )
     # for routed-push repair runs, hold the host-side stacked plans: the
     # incremental patcher splices rebuilt shards into them at repair events

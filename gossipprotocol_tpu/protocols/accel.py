@@ -188,6 +188,11 @@ def estimate_gamma(topo: Topology, iters: int = 200, seed: int = 0) -> float:
     if topo.implicit_full:
         # K_n diffusion mixes in one round; Chebyshev degenerates to plain
         return 0.0
+    if hasattr(topo, "csr_slice"):
+        raise ValueError(
+            "γ estimation power-iterates the global CSR on the host, "
+            "which a streamed topology build never materializes — pass "
+            "--accel-lambda or use --build materialized")
     n = topo.num_nodes
     offsets = np.asarray(topo.offsets, dtype=np.int64)
     src = np.repeat(np.arange(n, dtype=np.int64), np.diff(offsets))

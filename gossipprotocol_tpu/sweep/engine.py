@@ -363,7 +363,7 @@ def run_sweep(topo: Topology, cfg) -> SweepResult:
         [jax.random.key(lc.seed) for lc in lane_cfgs])
     lane_params = _lane_params(spec, lane_cfgs, template)
 
-    edges = None if topo.implicit_full else int(topo.indices.size)
+    edges = None if topo.implicit_full else int(topo.num_directed_edges)
     counter_slots = (template.resolve_chunk_rounds(n, edges)
                      if tel.counters_on else None)
     if spec.traced_names or counter_slots is not None:
@@ -488,7 +488,7 @@ def _drive_sweep(topo, cfg, spec, lane_cfgs, state, step, compile_ms,
     B = spec.lanes
     n = topo.num_nodes
     chunk_rounds = cfg.resolve_chunk_rounds(
-        n, None if topo.implicit_full else int(topo.indices.size))
+        n, None if topo.implicit_full else int(topo.num_directed_edges))
     budget = int(cfg.round_budget) if cfg.round_budget is not None else None
     metrics: List[dict] = []
     lane_counters = np.zeros((B, 3), np.int64)

@@ -129,6 +129,24 @@ class Topology:
         object.__setattr__(self, "_birth_alive_cache", result)
         return result
 
+    def adjacency_digest(self) -> str:
+        """Collision-resistant content address of the adjacency —
+        the compiled-plan cache key (see ``ops.plancache.cache_key``,
+        which delegates here). ``topology.stream.ShardedTopology``
+        reproduces the same digest from per-shard slices without ever
+        concatenating them, so plan-cache behavior is provably
+        independent of which build produced the graph."""
+        if self.implicit_full:
+            raise ValueError(
+                "the implicit complete graph has no CSR to digest")
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        h.update(str(self.num_nodes).encode())
+        h.update(np.ascontiguousarray(self.offsets))
+        h.update(np.ascontiguousarray(self.indices))
+        return f"{self.num_nodes}-{h.hexdigest()}"
+
     def validate(self) -> None:
         """Structural sanity checks (used by tests and the CLI --check flag)."""
         if self.implicit_full:
@@ -163,10 +181,17 @@ def csr_from_edges(num_nodes: int, edges: np.ndarray, kind: str) -> Topology:
             f"num_nodes={num_nodes} exceeds int32 CSR index range"
         )
     edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    # int64 safety: the symmetrized directed count is 2*len(edges); past
+    # int32 range the native binding's index buffers are no longer
+    # trustworthy (C int arithmetic), so route to the numpy path, whose
+    # arithmetic is int64 throughout. The sort key src*n + dst cannot
+    # overflow int64 here: both factors are < 2**31 by the guard above.
+    if len(edges) * 2 >= 2**31:
+        built = None
+    else:
+        from gossipprotocol_tpu import native
 
-    from gossipprotocol_tpu import native
-
-    built = native.csr_build(num_nodes, edges[:, 0], edges[:, 1])
+        built = native.csr_build(num_nodes, edges[:, 0], edges[:, 1])
     if built is not None:
         offsets, indices = built
     else:
@@ -195,3 +220,40 @@ def csr_from_edges(num_nodes: int, edges: np.ndarray, kind: str) -> Topology:
         offsets=offsets.astype(otype),
         indices=indices,
     )
+
+
+def csr_from_edge_chunks(num_nodes: int, chunks, kind: str,
+                         memory_budget: Optional[int] = None,
+                         num_buckets: int = 8) -> Topology:
+    """Streamed sibling of :func:`csr_from_edges`: consumes an iterable
+    of edge chunks — ``(src, dst)`` array pairs or ``[k, 2]`` edge
+    arrays — and produces the byte-identical canonical Topology with
+    the global edge list never held — build
+    workspace is O(E/num_buckets + chunk) (plus ``memory_budget`` of
+    pair buffering before disk spill), and the final CSR arrays are the
+    only O(E) allocation. Indptr arithmetic is int64 throughout, with
+    the same int32 compaction policy as the materialized path.
+    """
+    from gossipprotocol_tpu.topology import stream as stream_mod
+
+    if num_nodes > 2**31 - 1:
+        raise ValueError(
+            f"num_nodes={num_nodes} exceeds int32 CSR index range"
+        )
+    def _pairs():
+        for chunk in chunks:
+            if isinstance(chunk, tuple):
+                src, dst = chunk
+            else:
+                arr = np.asarray(chunk, dtype=np.int64).reshape(-1, 2)
+                src, dst = arr[:, 0], arr[:, 1]
+            yield (np.asarray(src, dtype=np.int64),
+                   np.asarray(dst, dtype=np.int64))
+
+    it = _pairs()
+    es = stream_mod.EdgeStream(kind, num_nodes, lambda: it,
+                               cheap_replay=False)
+    sharded = stream_mod.build_sharded_topology(
+        es, max(1, min(num_buckets, num_nodes)), mode="spill",
+        memory_budget=memory_budget)
+    return sharded.materialize()

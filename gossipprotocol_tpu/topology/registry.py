@@ -66,7 +66,17 @@ def build_topology(name: str, num_nodes: int, **kwargs) -> Topology:
 
     Builder-specific kwargs (``seed``, ``avg_degree``, ``m``) pass through;
     builders that don't take them have them filtered out.
+
+    ``edgefile:PATH`` loads an edge list from disk (whitespace ``u v``
+    lines) via the chunked importer — ``num_nodes`` may be 0/None to
+    infer the node count from the file.
     """
+    from gossipprotocol_tpu.topology import stream
+
+    if name.startswith(stream.EDGEFILE_PREFIX):
+        path = name[len(stream.EDGEFILE_PREFIX):]
+        return stream.topology_from_stream(
+            stream.edge_file_stream(path, num_nodes or None))
     canonical = _ALIASES.get(name.lower(), name)
     if canonical not in _REGISTRY:
         raise ValueError(

@@ -206,6 +206,11 @@ def topology_fingerprint(topo) -> str:
 
     if topo.implicit_full:
         return f"full/{topo.num_nodes}"
+    streamed = getattr(topo, "fingerprint", None)
+    if streamed is not None:
+        # a streamed ShardedTopology crc's its slices in order — same
+        # byte stream, same fingerprint as the materialized CSR
+        return streamed()
     crc = zlib.crc32(topo.indices.tobytes())
     crc = zlib.crc32(topo.offsets.tobytes(), crc)
     return f"{topo.num_nodes}/{topo.num_directed_edges}/{crc:08x}"
