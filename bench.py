@@ -443,6 +443,69 @@ def _sweep_microbench() -> None:
     }))
 
 
+def _sentinel_microbench() -> None:
+    """``BENCH_SENTINEL=1``: health-sentinel overhead at the headline scale.
+
+    Runs the same 1M-node push-sum diffusion twice — sentinel off, then
+    ``sentinel='on'`` (the per-chunk finite/positivity check ORed into the
+    loop cond plus the host mass tripwire) — and prints ONE JSON line
+    with both wall times and the on/off ratio. The correctness oracle
+    runs first: a healthy sentinel-on run must be bitwise identical to
+    the off run in-loop (the sentinel only observes; it never feeds back
+    into the round), so a wrong-fast datapoint cannot land.
+
+    Knobs: ``BENCH_SENTINEL_NODES`` (default 1M),
+    ``BENCH_SENTINEL_MAX_ROUNDS`` (default 200k).
+    """
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+
+    from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+
+    n = int(os.environ.get("BENCH_SENTINEL_NODES", 1_000_000))
+    max_rounds = int(os.environ.get("BENCH_SENTINEL_MAX_ROUNDS", 200_000))
+    topo = build_topology("imp3D", n, seed=0)
+    base = RunConfig(algorithm="push-sum", seed=0, max_rounds=max_rounds)
+
+    res_off = run_simulation(topo, base)
+    assert res_off.converged, (
+        f"sentinel-off run did not converge: {res_off.rounds} rounds")
+    res_on = run_simulation(
+        topo, dataclasses.replace(base, sentinel="on"))
+    assert res_on.converged, (
+        f"sentinel-on run did not converge: {res_on.rounds} rounds")
+    # correctness oracle before any overhead claim: the sentinel must be
+    # observation-only on a healthy run, bitwise
+    assert res_on.rounds == res_off.rounds, (
+        f"sentinel changed the round count: {res_off.rounds} -> "
+        f"{res_on.rounds}")
+    bitwise = all(
+        np.array_equal(np.asarray(jax.device_get(a)),
+                       np.asarray(jax.device_get(b)))
+        for a, b in zip(jax.tree_util.tree_leaves(res_off.final_state),
+                        jax.tree_util.tree_leaves(res_on.final_state)))
+    assert bitwise, "sentinel-on trajectory is not bitwise the off one"
+
+    print(json.dumps({
+        "metric": "sentinel_overhead_pushsum_imp3d",
+        "nodes": topo.num_nodes,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "bitwise_equal": True,
+        "rounds": res_off.rounds,
+        "off_wall_s": round(res_off.wall_ms / 1e3, 4),
+        "on_wall_s": round(res_on.wall_ms / 1e3, 4),
+        "off_compile_s": round(res_off.compile_ms / 1e3, 3),
+        "on_compile_s": round(res_on.compile_ms / 1e3, 3),
+        "value": round(res_on.wall_ms / max(res_off.wall_ms, 1e-9), 4),
+        "unit": "on/off wall ratio",
+        "peak_rss_bytes": _peak_rss(),
+    }))
+
+
 def main():
     if os.environ.get("BENCH_BUILD_ONLY", "0") == "1":
         # pure host-side construction — no accelerator probe needed
@@ -457,6 +520,10 @@ def main():
 
     if os.environ.get("BENCH_SWEEP_LANES", "0") != "0":
         _sweep_microbench()
+        return
+
+    if os.environ.get("BENCH_SENTINEL", "0") == "1":
+        _sentinel_microbench()
         return
 
     import jax

@@ -163,6 +163,7 @@ def _build_config(args, algo, fault_schedule, jnp, event_plan=None,
         fault_schedule=fault_schedule,
         event_plan=event_plan,
         repair=args.repair,
+        sentinel=args.sentinel,
         round_budget=round_budget,
     )
 
@@ -264,6 +265,8 @@ ShardedTopology` — per-shard CSR slices, peak host RSS O(E/shards +
             and args.delivery in ("routed", "pallas")
             and args.repair == "off"
             and args.event_plan is None and args.churn is None
+            and args.value_faults is None
+            and args.sentinel in ("off", "on")
         )
     if sharded:
         return stream.build_sharded_topology(
@@ -594,7 +597,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "a fresh process. With --checkpoint-dir/--checkpoint-"
                         "every the run resumes from the latest checkpoint; "
                         "without, it restarts from scratch. Single-process "
-                        "only (rejected with --devices > 1: uncoordinated "
+                        "only (a single-process multi-device mesh is fine — "
+                        "the recovery exec re-owns the whole mesh — but "
+                        "multi-process runs are rejected: uncoordinated "
                         "per-process re-execs would race the distributed "
                         "mesh init)")
     p.add_argument("--restarted", action="store_true",
@@ -645,6 +650,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "(membership churn), 'swap' crosses edge pairs "
                         "degree-preservingly (mobility). Deterministic from "
                         "--seed; combines with --event-plan")
+    p.add_argument("--value-faults", type=str, default=None,
+                   metavar="RATE,MODEL[,ROUND]",
+                   help="seeded data-fault sugar: at round ROUND (default "
+                        "10) corrupt the push-sum s/payload of RATE of the "
+                        "nodes — model 'nan'/'inf' poisons them outright, "
+                        "'stuck' resets them to their initial value, "
+                        "'scale:K' multiplies by K (a silent adversarial "
+                        "shift). Victims draw deterministically from --seed "
+                        "(shard-count invariant); combines with --event-plan "
+                        "(the 'value_faults' JSON key). Push-sum only. Pair "
+                        "with --sentinel to detect/contain")
+    p.add_argument("--sentinel", nargs="?", const="on", default="off",
+                   choices=("off", "on", "quarantine", "rollback"),
+                   help="on-device health sentinel folded through the chunk "
+                        "loop: per-chunk all-finite check on (s, w, payload)"
+                        ", w-positivity, and a host mass-drift tripwire. "
+                        "'on' detects and stops; 'quarantine' additionally "
+                        "kills the offending rows through the event engine "
+                        "(--repair rewire reknits survivors) and continues; "
+                        "'rollback' restores the newest checkpoint "
+                        "predating the trip (needs --checkpoint-dir/-every) "
+                        "and replays with the quarantine inserted. Off = "
+                        "zero cost: the compiled programs are bitwise "
+                        "identical to a sentinel-free build")
     p.add_argument("--repair", choices=["off", "prune", "rewire"],
                    default="off",
                    help="self-healing topology repair at fault events. "
@@ -876,6 +905,20 @@ def main(argv=None) -> int:
             event_plan = dataclasses.replace(
                 event_plan if event_plan is not None else EventPlan(),
                 churn=spec)
+        if args.value_faults is not None:
+            from gossipprotocol_tpu.events import (
+                EventPlan,
+                parse_value_faults_arg,
+            )
+
+            vf = parse_value_faults_arg(args.value_faults)
+            if event_plan is not None and event_plan.value_faults:
+                raise ValueError(
+                    "--value-faults and an event-plan 'value_faults' list "
+                    "both given — configure one")
+            event_plan = dataclasses.replace(
+                event_plan if event_plan is not None else EventPlan(),
+                value_faults=(vf,))
         if event_plan is not None and topo.implicit_full:
             raise ValueError(
                 "event plans need an explicit edge list; the implicit "
@@ -966,14 +1009,21 @@ def main(argv=None) -> int:
                     "mesh needs XLA_FLAGS="
                     "--xla_force_host_platform_device_count=N)"
                 )
-        if args.auto_resume > 0 and args.devices > 1:
-            raise ValueError(
-                "--auto-resume is single-process only: each process would "
-                "independently re-exec after a fixed grace sleep with no "
-                "barrier before re-initializing the distributed runtime, "
-                "leaving a hung or mismatched mesh — recover multi-process "
-                "runs by relaunching the job from --checkpoint-dir"
-            )
+        if args.auto_resume > 0:
+            # single-process multi-device meshes re-exec fine (one process
+            # owns the whole mesh, so the recovery exec re-initializes it
+            # alone); only a *multi-process* runtime is unrecoverable here
+            import jax as _jax2
+
+            if _jax2.process_count() > 1:
+                raise ValueError(
+                    "--auto-resume is single-process only: each process "
+                    "would independently re-exec after a fixed grace sleep "
+                    "with no barrier before re-initializing the distributed "
+                    "runtime, leaving a hung or mismatched mesh — recover "
+                    "multi-process runs by relaunching the job from "
+                    "--checkpoint-dir"
+                )
         if args.sweep is not None or args.sweep_seeds is not None:
             if args.sweep is not None and args.sweep_seeds is not None:
                 raise ValueError(
@@ -1089,6 +1139,13 @@ def main(argv=None) -> int:
         if problems:
             print("checkpoint mismatch: " + "; ".join(problems), file=sys.stderr)
             return 2
+        if meta.get("quarantines"):
+            # quarantines the checkpoint lived through (sentinel
+            # containment): replay them into the topology reconstruction
+            # so the resumed run continues on the same graph and dead set
+            cfg = dataclasses.replace(cfg, quarantine_log=tuple(
+                (int(r), tuple(int(i) for i in ids))
+                for r, ids in meta["quarantines"]))
         if cfg.delivery == "invert":
             # same build-time precondition the pre-flight block above
             # surfaces for fresh runs: a faulted checkpoint's dead set is

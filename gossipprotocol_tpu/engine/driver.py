@@ -29,7 +29,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from functools import partial
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -239,6 +239,25 @@ class RunConfig:
                                    # standalone run with lane i's config,
                                    # so this is not a trajectory field —
                                    # it is B trajectories
+    sentinel: str = "off"          # on-device health sentinel: "off"
+                                   # (zero cost — the compiled chunk is
+                                   # byte-identical to the pre-sentinel
+                                   # program) | "on" (detect + record
+                                   # only) | "quarantine" (kill + zero
+                                   # offending rows through the host-
+                                   # event pipeline) | "rollback"
+                                   # (quarantine + restore the newest
+                                   # checkpoint predating the trip and
+                                   # replay). NOT a trajectory field:
+                                   # like telemetry it only observes —
+                                   # the quarantines it performs are
+                                   # persisted in checkpoint metadata
+    quarantine_log: Tuple = ()     # ((round, (ids...)), ...) quarantines
+                                   # a resumed checkpoint lived through
+                                   # (from its "quarantines" metadata) —
+                                   # replayed into the adjacency exactly
+                                   # like scheduled kills. Populated by
+                                   # the resume path, not by users
 
     @property
     def schedule(self):
@@ -641,6 +660,61 @@ class RunConfig:
                 raise ValueError(
                     "round_budget must be None, a positive int, or 'auto'"
                 )
+        if self.sentinel not in ("off", "on", "quarantine", "rollback"):
+            raise ValueError(
+                "sentinel must be 'off', 'on', 'quarantine', or 'rollback'"
+            )
+        if plan.value_faults:
+            if self.algorithm != "push-sum":
+                raise ValueError(
+                    "value faults corrupt push-sum's (s, w) payload; "
+                    "gossip carries no numeric mass to poison — use "
+                    "algorithm='push-sum'"
+                )
+            # reference semantics / megakernel / invert / accel / gala
+            # already reject any non-empty plan above — the matrix entries
+            # for value-fault plans fall out of those checks
+        if self.sentinel != "off":
+            if self.algorithm != "push-sum":
+                raise ValueError(
+                    "the health sentinel checks push-sum's (s, w) mass "
+                    "invariants; gossip has none — use "
+                    "algorithm='push-sum'"
+                )
+            if self.semantics == "reference":
+                raise ValueError(
+                    "the sentinel guards the mass-conserving push-sum "
+                    "state; semantics='reference' replays the F# walk "
+                    "and rejects it"
+                )
+            if self.delivery == "megakernel" or self.rounds_per_kernel > 1:
+                raise ValueError(
+                    "the sentinel folds its health check through the "
+                    "per-round chunk loop; the round-loop megakernel "
+                    "checks nothing between its fused rounds — use "
+                    "delivery='pallas' with rounds_per_kernel=1"
+                )
+            if self.sweep is not None:
+                raise ValueError(
+                    "the sentinel quarantines through the host-event "
+                    "pipeline, which the vmapped sweep lanes do not "
+                    "carry — run sentinel runs unswept"
+                )
+            if self.accel != "off" and self.sentinel in ("quarantine",
+                                                         "rollback"):
+                raise ValueError(
+                    "sentinel quarantine kills nodes mid-run; accel "
+                    "assumes a fixed mixing matrix — use sentinel='on' "
+                    "for detection only"
+                )
+        if self.sentinel == "rollback" and not (
+            self.checkpoint_every and self.checkpoint_dir
+        ):
+            raise ValueError(
+                "sentinel='rollback' restores the newest checkpoint "
+                "predating a trip; it requires checkpoint_every AND "
+                "checkpoint_dir"
+            )
 
     def resolve_chunk_rounds(
         self, num_nodes: int, num_edges: Optional[int] = None
@@ -1371,7 +1445,7 @@ def mass_stats(state, all_sum=sum0) -> dict:
 def make_chunk_runner(round_core, done_fn, extra_stats=None,
                       counter_fn=None, counter_slots=0,
                       trace_fn=None, trace_slots=0, *,
-                      rounds_per_step=1):
+                      rounds_per_step=1, sentinel_fn=None):
     """jitted ``(state, nbrs, base_key, round_limit) -> (state, stats)``:
     advance rounds until global convergence or ``state.round ==
     round_limit``. The supervisor predicate is evaluated in the loop
@@ -1404,17 +1478,37 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
     through the scan under the same contract: unset keeps the literal
     counter-only (or pre-telemetry) program; set never feeds back into
     the round, so the state trajectory stays bitwise identical.
+
+    ``sentinel_fn`` (the health sentinel, ``make_sentinel_fn``) joins the
+    loop *condition* only: the chunk exits at the first round whose state
+    trips it (the trip condition persists in the state — NaN stays NaN —
+    so the post-loop stats re-detect it), leaving every body, carry and
+    buffer untouched. Unset, ``stop_fn is done_fn`` and the traced
+    program is the literal pre-sentinel one (the goldens' byte-identical
+    zero-cost-off contract); set, it adds a ``sentinel_trip`` stat plus
+    the mass totals the host tripwire compares.
     """
+    stop_fn = (done_fn if sentinel_fn is None
+               else lambda s: jnp.logical_or(done_fn(s), sentinel_fn(s)))
+
+    def sentinel_stats(final, stats):
+        if sentinel_fn is not None:
+            stats["sentinel_trip"] = sentinel_fn(final).astype(jnp.int32)
+            if "mass_s" not in stats:
+                stats.update(mass_stats(final))
+        return stats
+
     if counter_fn is None and trace_fn is None:
         def chunk(state, nbrs, base_key, round_limit):
             def body(s):
                 return round_core(s, nbrs, base_key)
 
             def cond(s):
-                return jnp.logical_and(~done_fn(s), s.round < round_limit)
+                return jnp.logical_and(~stop_fn(s), s.round < round_limit)
 
             final = jax.lax.while_loop(cond, body, state)
-            return final, stats_with_extra(final, done_fn, extra_stats)
+            return final, sentinel_stats(
+                final, stats_with_extra(final, done_fn, extra_stats))
 
         return jax.jit(chunk, donate_argnums=0)
 
@@ -1439,14 +1533,14 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
 
             def cond(carry):
                 s, _ = carry
-                return jnp.logical_and(~done_fn(s), s.round < round_limit)
+                return jnp.logical_and(~stop_fn(s), s.round < round_limit)
 
             buf0 = jnp.zeros((counter_slots + k - 1, 3), jnp.int32)
             final, buf = jax.lax.while_loop(cond, body, (state, buf0))
             stats = stats_with_extra(final, done_fn, extra_stats)
             stats["counters"] = buf
             stats.update(mass_stats(final))
-            return final, stats
+            return final, sentinel_stats(final, stats)
 
         return jax.jit(chunk, donate_argnums=0)
 
@@ -1478,7 +1572,7 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
 
         def cond(carry):
             s, _ = carry
-            return jnp.logical_and(~done_fn(s), s.round < round_limit)
+            return jnp.logical_and(~stop_fn(s), s.round < round_limit)
 
         bufs0 = {
             "trace": jnp.zeros((trace_slots + k - 1, NUM_TRACE_COLS),
@@ -1493,7 +1587,7 @@ def make_chunk_runner(round_core, done_fn, extra_stats=None,
         if counter_fn is not None:
             stats["counters"] = bufs["counters"]
             stats.update(mass_stats(final))
-        return final, stats
+        return final, sentinel_stats(final, stats)
 
     return jax.jit(chunk, donate_argnums=0)
 
@@ -1562,6 +1656,101 @@ def revive_rows(state, ids, cfg: RunConfig, num_nodes: int):
     )
 
 
+# Host mass-drift tripwire threshold (ULPs of the anchored baseline).
+# Far above the worst honest drift the observatory ever flagged
+# (DRIFT_ULP_TOL = 64 is the *anomaly* bar; exact-conservation runs sit
+# at 0), far below any adversarial scale:K injection's displacement.
+SENTINEL_MASS_ULPS = 256.0
+
+
+def sentinel_bad_mask(state):
+    """Per-row health predicate of the on-device sentinel: an *alive* row
+    is bad when its payload ``s`` has a non-finite component, or ``w`` is
+    non-finite or negative. ``w == 0`` is deliberately healthy — the
+    documented receipt-dry-spell underflow (``w_underflow`` warns), not a
+    data fault. Shared by the device trip check (any over local rows) and
+    the host's offending-row identification, so they cannot disagree."""
+    xp = jnp if isinstance(state.s, jax.Array) else np
+    s_bad = ~xp.isfinite(state.s)
+    if state.s.ndim == 2:
+        s_bad = s_bad.any(axis=1)
+    return state.alive & (s_bad | ~xp.isfinite(state.w) | (state.w < 0))
+
+
+def make_sentinel_fn(cfg: RunConfig):
+    """The single-chip sentinel trip predicate (``state -> bool``) for
+    :func:`make_chunk_runner`'s loop condition. The sharded engine wraps
+    :func:`sentinel_bad_mask` in its own psum reduction instead."""
+    del cfg  # the predicate is config-independent once sentinel is on
+
+    def sentinel_fn(state):
+        return jnp.any(sentinel_bad_mask(state))
+
+    return sentinel_fn
+
+
+def quarantine_rows(state, ids):
+    """Zero the protocol mass of rows ``ids`` on device — the first step
+    of a quarantine, BEFORE the synthetic kill fires through the event
+    pipeline: the poison (NaN/Inf/adversarial mass) must leave the sums
+    the instant the nodes leave the network, or every later conservation
+    snapshot and mass stat stays NaN forever. Same ``.at[].set`` +
+    sharding-restore discipline as :func:`revive_rows` (a zero-copy
+    device_put into a donated buffer would alias externally-owned
+    memory). Callers flip ``alive`` separately (the pipeline does)."""
+    idx = jnp.asarray(np.asarray(ids, np.int64), jnp.int32)
+
+    def put(field, values):
+        out = field.at[idx].set(values)
+        if out.sharding != field.sharding:
+            out = jax.device_put(out, field.sharding)
+        return out
+
+    out = state._replace(s=put(state.s, 0), w=put(state.w, 0))
+    if hasattr(state, "ratio"):
+        out = out._replace(ratio=put(state.ratio, 0))
+    return out
+
+
+def inject_value_fault(state, ids, spec, cfg: RunConfig, num_nodes: int):
+    """Apply one value-fault event to rows ``ids`` (already filtered to
+    live nodes): corrupt the push-sum numerator ``s`` per the spec's
+    model. ``w`` and the rest of the state are untouched — the fault
+    models a node whose *value* went wrong, not its protocol machinery.
+    Device-side ``.at[].set``/``.multiply``, same aliasing discipline as
+    :func:`revive_rows`."""
+    ids = np.asarray(ids, np.int64)
+    idx = jnp.asarray(ids, jnp.int32)
+    dt = np.dtype(state.s.dtype)
+
+    def put(out):
+        if out.sharding != state.s.sharding:  # compiled step expects layout
+            out = jax.device_put(out, state.s.sharding)
+        return out
+
+    model = str(spec.model).split(":", 1)[0]
+    if model == "nan":
+        return state._replace(s=put(state.s.at[idx].set(dt.type(np.nan))))
+    if model == "inf":
+        return state._replace(s=put(state.s.at[idx].set(dt.type(np.inf))))
+    if model == "scale":
+        k = dt.type(spec.scale_factor())
+        return state._replace(s=put(state.s.at[idx].multiply(k)))
+    # model == "stuck": payload resets to the node's initial value — a
+    # learner that stopped learning but keeps gossiping its stale state
+    if state.s.ndim == 2 and hasattr(state, "loss"):
+        vals_np = np.zeros((ids.size, state.s.shape[1]), dt)  # SGP x₀ = 0
+    elif state.s.ndim == 2:
+        from gossipprotocol_tpu.protocols.state import pushsum_payload_values
+
+        vals_np = pushsum_payload_values(
+            ids, num_nodes, state.s.shape[1], cfg.value_mode, dt, np)
+    else:
+        vals_np = (ids.astype(dt) / dt.type(num_nodes)
+                   if cfg.value_mode == "scaled" else ids.astype(dt))
+    return state._replace(s=put(state.s.at[idx].set(jnp.asarray(vals_np))))
+
+
 def compute_prediction(run_topo, cfg: RunConfig, tel) -> Optional[dict]:
     """Analytic round prediction for this run (obs/predict.py), computed
     once before compiling — on the host, from the topology CSR.
@@ -1615,6 +1804,7 @@ def _drive(
     rebuild: Optional[Callable] = None,
     run_topo: Optional[Topology] = None,
     prediction: Optional[dict] = None,
+    reload_fn: Optional[Callable] = None,
 ) -> RunResult:
     """Shared host loop for the single-chip and sharded engines.
 
@@ -1634,6 +1824,11 @@ def _drive(
     computed by the engine before compiling; it resolves
     ``cfg.round_budget == "auto"`` and is updated in place with the
     actual outcome so the manifest records predicted-vs-actual.
+
+    ``reload_fn(host_state) -> device state`` re-materializes a loaded
+    checkpoint state onto the engine's device layout (the sharded engine
+    pads and re-shards; default is a plain device copy). Only exercised
+    by ``cfg.sentinel == "rollback"``.
     """
     from gossipprotocol_tpu.events import HostEvents
     from gossipprotocol_tpu.obs import as_telemetry
@@ -1672,8 +1867,22 @@ def _drive(
     # once per run, not per checkpoint (crc over the CSR)
     adjacency = ckpt_mod.topology_fingerprint(topo) if checkpointing else None
 
+    sentinel_on = cfg.sentinel != "off"
+    # quarantines this trajectory lived through: the resumed prefix from
+    # the checkpoint metadata plus everything this process performs.
+    # Saved into every checkpoint (save extra_meta) so a later resume can
+    # replay these dynamic kills into the adjacency like scheduled ones.
+    quar_log = {int(r): np.asarray(ids, np.int64)
+                for r, ids in (cfg.quarantine_log or ())}
+
+    def quar_meta():
+        if not quar_log:
+            return None
+        return {"quarantines": [[r, quar_log[r].tolist()]
+                                for r in sorted(quar_log)]}
+
     mass_base = None
-    if tel.counters_on:
+    if tel.counters_on or sentinel_on:
         # anchor the conservation baseline with the *same compiled
         # reduction* the chunk stats use: a no-op chunk (round_limit=-1,
         # the warm-start trick — the body never runs) returns the mass
@@ -1731,6 +1940,7 @@ def _drive(
             host = jax.device_get(stats)  # the one blocking transfer per chunk
         cur_round = int(host.pop("round"))
         done = bool(host.pop("done"))
+        trip_dev = bool(host.pop("sentinel_trip", 0))
         counters = host.pop("counters", None)
         shard_counters = host.pop("shard_counters", None)
         trace_buf = host.pop("trace", None)
@@ -1767,12 +1977,20 @@ def _drive(
                         f"{[sent, delivered, dropped]} (round={cur_round})"
                     )
                 tel.add_shard_counters(per_shard)
+        mass_trip = False
         if chunk_mass[0] is not None and mass_base is not None:
             s_ulps = ulp_drift(chunk_mass[0], mass_base[0])
             w_ulps = ulp_drift(chunk_mass[1], mass_base[1])
             rec["mass_drift_ulps"] = s_ulps
             rec["w_drift_ulps"] = w_ulps
             tel.note_mass_drift(s_ulps, w_ulps)
+            if sentinel_on:
+                # host mass-drift tripwire: conservation displaced far
+                # beyond honest rounding (or into NaN/Inf, where the ULP
+                # measure itself degenerates)
+                mass_trip = any(
+                    (not np.isfinite(u)) or u > SENTINEL_MASS_ULPS
+                    for u in (s_ulps, w_ulps))
         if rec.get("w_underflow", 0) and not underflow_warned:
             # measured failure mode (README "Convergence-predicate
             # soundness", 100M artifact): warn once with the cures
@@ -1795,16 +2013,161 @@ def _drive(
             # the reference's Actor2 hole); grinding to max_rounds is
             # pointless
             rec["stalled"] = True
+        trip = trip_dev or mass_trip
+        if trip:
+            rec["sentinel_trip"] = True
         metrics.append(rec)
         tel.metric(rec)
         if cfg.metrics_callback:
             cfg.metrics_callback(rec)
+        if trip:
+            # sentinel trip handling, BEFORE the checkpoint save: under
+            # quarantine/rollback no poisoned state is ever published, so
+            # every checkpoint on disk predates its trip by construction
+            # (what makes "newest checkpoint predating the trip" sound).
+            # Offender identification is host-side from the fetched state
+            # — bitwise invariant across shard counts, so the quarantined
+            # set (and everything downstream) is too.
+            bad_host = ckpt_mod.fetch_host(trim(state))
+            bad_ids = np.flatnonzero(np.asarray(sentinel_bad_mask(bad_host)))
+            ev = {
+                "event": "sentinel_trip",
+                "round": cur_round,
+                "cause": "nonfinite" if trip_dev else "mass_drift",
+                "nodes": int(bad_ids.size),
+                "mode": cfg.sentinel,
+            }
+            metrics.append(ev)
+            tel.metric(ev)
+            tel.event("sentinel_trip",
+                      **{k: v for k, v in ev.items() if k != "event"})
+            if cfg.metrics_callback:
+                cfg.metrics_callback(ev)
+
+            def requarantine(at_round, ids):
+                nonlocal state, run_topo, step
+                state, run_topo, new_step, q_recs = host_events.quarantine(
+                    state, run_topo, at_round, ids, rebuild)
+                if new_step is not None:
+                    step = new_step
+                for qr in q_recs:
+                    metrics.append(qr)
+                    tel.metric(qr)
+                    if cfg.metrics_callback:
+                        cfg.metrics_callback(qr)
+                    if qr.get("event") == "quarantine":
+                        tel.event("quarantine", round=at_round,
+                                  nodes=qr["nodes"], policy=qr["policy"])
+                quar_log[at_round] = np.union1d(
+                    quar_log.get(at_round, np.empty(0, np.int64)), ids)
+
+            def reanchor():
+                nonlocal state, mass_base
+                if mass_base is None:
+                    return
+                state, _bs = step(state, -1)
+                _bh = jax.device_get(_bs)
+                mass_base = (_bh["mass_s"], _bh["mass_w"])
+
+            if cfg.sentinel in ("quarantine", "rollback") and bad_ids.size:
+                if rebuild is None:
+                    raise RuntimeError(
+                        "sentinel tripped but the engine supplied no "
+                        "rebuild hook for quarantine")
+                target = None
+                if cfg.sentinel == "rollback":
+                    # newest readable checkpoint strictly predating the
+                    # trip (all published ones are clean, see above)
+                    for path in ckpt_mod.candidates(cfg.checkpoint_dir):
+                        try:
+                            c_meta = ckpt_mod.peek_meta(path)
+                        except Exception:
+                            continue
+                        if int(c_meta.get("round", cur_round)) < cur_round:
+                            target = (path, int(c_meta["round"]))
+                            break
+                if target is not None:
+                    c_path, c_round = target
+                    with tel.span("sentinel_rollback", round=cur_round,
+                                  target_round=c_round):
+                        rb_state, _rb_meta = ckpt_mod.load(c_path)
+                        # quarantines from the now-abandoned timeline
+                        # (r > C) are dropped; one at exactly C merges
+                        # with the new bad set — the restored state
+                        # predates it, so it must be re-applied whole
+                        merged = np.union1d(
+                            quar_log.get(c_round, np.empty(0, np.int64)),
+                            bad_ids)
+                        quar_log = {r: v for r, v in quar_log.items()
+                                    if r < c_round}
+                        from gossipprotocol_tpu.events import (
+                            replay_topology_events,
+                        )
+
+                        run_topo = replay_topology_events(
+                            topo, cfg.schedule, cfg.events, cfg.repair,
+                            cfg.seed, c_round, quarantines=quar_log)
+                        state = (reload_fn if reload_fn is not None
+                                 else lambda st: jax.tree.map(jnp.array, st)
+                                 )(rb_state)
+                        # fresh engine at C restores the events the old
+                        # instance already consumed on the abandoned path
+                        host_events = HostEvents(topo, cfg,
+                                                 start_round=c_round,
+                                                 tel=tel)
+                        prev_topo = run_topo
+                        requarantine(c_round, merged)
+                        if run_topo is prev_topo and (
+                                cfg.repair != "off"
+                                or cfg.events.has_events):
+                            # the quarantine itself changed nothing, but
+                            # the adjacency at C can still differ from
+                            # the one the current compiled step was built
+                            # against (the abandoned timeline evolved it)
+                            step, state, _ = rebuild(run_topo, state)
+                        cur_round = c_round
+                        rb_rec = {"event": "rollback", "round": cur_round,
+                                  "from_round": int(ev["round"]),
+                                  "checkpoint": c_path,
+                                  "nodes": int(merged.size)}
+                        metrics.append(rb_rec)
+                        tel.metric(rb_rec)
+                        tel.event("rollback", round=cur_round,
+                                  from_round=int(ev["round"]),
+                                  nodes=int(merged.size))
+                        if cfg.metrics_callback:
+                            cfg.metrics_callback(rb_rec)
+                else:
+                    if cfg.sentinel == "rollback":
+                        # no checkpoint predates the trip (it fired before
+                        # the first save) — contain in place instead
+                        fb = {"event": "rollback_fallback",
+                              "round": cur_round,
+                              "reason": "no checkpoint predates the trip"}
+                        metrics.append(fb)
+                        tel.metric(fb)
+                        if cfg.metrics_callback:
+                            cfg.metrics_callback(fb)
+                    requarantine(cur_round, bad_ids)
+                # quarantine zeroed rows: the conserved quantity itself
+                # legitimately changed — re-anchor the drift baseline
+                reanchor()
+                continue
+            if trip_dev:
+                # detect-only mode cannot remove the poison, and a
+                # tripped state re-trips the loop condition forever:
+                # record and stop (the run could never converge anyway)
+                break
+            # unattributable mass-level trip (e.g. a finite scale:K
+            # displacement) with no containable rows: accept the new mass
+            # level so one shift does not re-trip every following chunk
+            reanchor()
         if checkpointing and chunk_i % cfg.checkpoint_every == 0:
             with tel.span("checkpoint_save", round=cur_round):
                 checkpoints.append(
                     ckpt_mod.save(
                         cfg.checkpoint_dir, trim(state), cfg, topo.kind,
-                        adjacency=adjacency,
+                        adjacency=adjacency, extra_meta=quar_meta(),
                     )
                 )
         if budget is not None and not done and cur_round >= budget:
@@ -1883,7 +2246,7 @@ def run_simulation(
             )
         return run_sweep(topo, cfg)
     run_topo = topo
-    if (cfg.repair != "off" or cfg.events.has_events) \
+    if (cfg.repair != "off" or cfg.events.has_events or cfg.quarantine_log) \
             and initial_state is not None:
         # the run's adjacency is a function of (birth topo, schedule,
         # event plan, policy, seed): replay the event rounds the
@@ -1951,6 +2314,7 @@ def run_simulation(
     prediction = compute_prediction(run_topo, cfg, tel)
 
     rounds_per_step = cfg.rounds_per_kernel if use_megakernel(cfg) else 1
+    sentinel_fn = make_sentinel_fn(cfg) if cfg.sentinel != "off" else None
 
     runner = make_chunk_runner(
         round_core, done_fn, extra_stats,
@@ -1959,6 +2323,7 @@ def run_simulation(
         trace_fn=engine_trace_fn(run_topo),
         trace_slots=counter_slots,
         rounds_per_step=rounds_per_step,
+        sentinel_fn=sentinel_fn,
     )
 
     t0 = time.perf_counter()
@@ -1995,6 +2360,7 @@ def run_simulation(
             trace_fn=engine_trace_fn(new_topo),
             trace_slots=counter_slots,
             rounds_per_step=rounds_per_step,
+            sentinel_fn=sentinel_fn,
         )
         compiled2 = runner2.lower(st, nbrs2, base_key, jnp.int32(0)).compile()
         tel.record_compiled(

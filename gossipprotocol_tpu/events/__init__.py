@@ -17,13 +17,17 @@ compile down to this engine; event plans add the new axis on top.
 
 from gossipprotocol_tpu.events.plan import (  # noqa: F401
     CHURN_MODELS,
+    VALUE_FAULT_MODELS,
     ChurnSpec,
     EventPlan,
+    ValueFaultSpec,
     apply_edge_events,
     as_plan,
     generate_churn,
     parse_churn_arg,
     parse_event_plan,
+    parse_value_faults_arg,
+    value_fault_ids,
 )
 from gossipprotocol_tpu.events.engine import (  # noqa: F401
     HostEvents,
@@ -33,14 +37,18 @@ from gossipprotocol_tpu.events.engine import (  # noqa: F401
 
 __all__ = [
     "CHURN_MODELS",
+    "VALUE_FAULT_MODELS",
     "ChurnSpec",
     "EventPlan",
     "HostEvents",
+    "ValueFaultSpec",
     "apply_edge_events",
     "as_plan",
     "generate_churn",
     "parse_churn_arg",
     "parse_event_plan",
+    "parse_value_faults_arg",
     "replay_topology",
     "replay_topology_events",
+    "value_fault_ids",
 ]

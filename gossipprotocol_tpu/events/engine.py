@@ -127,6 +127,12 @@ class HostEvents:
         self.adds = keep(self.plan.adds)
         self.removes = keep(self.plan.removes)
         self.swaps = keep(self.plan.swaps)
+        # value-fault injections by round (a checkpoint at C reflects the
+        # corruption of every fault with r < C — it is in the state)
+        self.value_faults: dict = {}
+        for vf in self.plan.value_faults:
+            if int(vf.round) >= start_round:
+                self.value_faults.setdefault(int(vf.round), []).append(vf)
         # next unfired churn round (None without a generator); a resumed
         # run starts at the first multiple of the period >= start_round
         self._churn_next = self.plan.next_churn_round(start_round)
@@ -137,7 +143,7 @@ class HostEvents:
         """Round of the next pending event; the drive loop stops each
         chunk exactly here so no event can be skipped."""
         cands = [*self.kills, *self.revives, *self.adds, *self.removes,
-                 *self.swaps]
+                 *self.swaps, *self.value_faults]
         if self._churn_next is not None:
             cands.append(self._churn_next)
         return min(cands, default=default)
@@ -164,10 +170,13 @@ class HostEvents:
                         for r in ev if r <= cur_round}
                        | set(_due_churn_rounds(self.plan, self._churn_next,
                                                cur_round)))
+        due_v = sorted(r for r in self.value_faults if r <= cur_round)
         span_attrs = dict(round=cur_round, kills=len(due_k),
                           revives=len(due_r))
         if due_e:
             span_attrs["edge_events"] = len(due_e)
+        if due_v:
+            span_attrs["value_faults"] = len(due_v)
         with tel.span("fault_event", **span_attrs):
             alive_host = np.array(ckpt_mod.fetch_host(state.alive))
             before = alive_host.copy()
@@ -268,7 +277,12 @@ class HostEvents:
                 new_step, state, info = rebuild(run_topo, state)
                 rebuild_s = time.perf_counter() - t0r
                 mass1 = _mass_snapshot(state)
-                if mass0 != mass1:
+                # NaN/Inf mass (a prior sentinel-off value fault) makes
+                # the equality meaningless — the rebuild is still sound,
+                # the state was already poisoned before it
+                finite = (mass0 is None
+                          or all(np.isfinite(v) for v in mass0))
+                if finite and mass0 != mass1:
                     raise AssertionError(
                         f"event rebuild changed protocol mass: "
                         f"{mass0} -> {mass1} (policy={cfg.repair}, "
@@ -298,11 +312,71 @@ class HostEvents:
                     **edge_stats,
                     **info,
                 })
+
+            # value-fault injection LAST: the corruption must never leak
+            # into the rebuild's conservation snapshot, and the sample is
+            # filtered by the final alive mask so already-quarantined
+            # (dead) rows stay untouched — the property that makes a
+            # post-rollback replay of the fault a no-op
+            for r in due_v:
+                for vf in self.value_faults.pop(r):
+                    from gossipprotocol_tpu.engine.driver import (
+                        inject_value_fault,
+                    )
+
+                    drawn = plan_mod.value_fault_ids(
+                        topo.num_nodes, vf, run_seed=cfg.seed)
+                    hit = drawn[alive_host[drawn]]
+                    if hit.size:
+                        state = inject_value_fault(state, hit, vf, cfg,
+                                                   topo.num_nodes)
+                    records.append({
+                        "event": "value_fault",
+                        "round": cur_round,
+                        "fault_round": int(vf.round),
+                        "model": str(vf.model),
+                        "rate": float(vf.rate),
+                        "drawn": int(drawn.size),
+                        "nodes": int(hit.size),
+                    })
         return state, run_topo, new_step, records, int(reborn.size)
+
+    def quarantine(self, state, run_topo, cur_round: int, ids, rebuild):
+        """Quarantine ``ids`` at ``cur_round``: a synthetic kill through
+        the normal pipeline, with one twist — the offending rows' mass is
+        zeroed on device FIRST, so the poison (NaN/Inf/adversarial mass)
+        leaves the network the instant the nodes do and the rebuild's
+        conservation snapshot stays finite.
+
+        Everything due at ``cur_round`` co-fires in the same pipeline
+        pass (exactly how the resume replay merges a logged quarantine
+        into the scheduled kills of the same round), so live and replayed
+        topology sequences stay bitwise-identical. Returns
+        ``(state, run_topo, new_step_or_None, records)``.
+        """
+        from gossipprotocol_tpu.engine.driver import quarantine_rows
+
+        ids = np.sort(np.asarray(ids, np.int64).reshape(-1))
+        state = quarantine_rows(state, ids)
+        prev = self.kills.get(cur_round)
+        self.kills[cur_round] = (
+            ids if prev is None
+            else np.unique(np.concatenate([prev, ids])))
+        state, run_topo, new_step, records, _reborn = self.fire(
+            state, run_topo, cur_round, rebuild)
+        records.append({
+            "event": "quarantine",
+            "round": cur_round,
+            "nodes": int(ids.size),
+            "ids": ids[:64].tolist(),
+            "policy": self.cfg.repair,
+        })
+        return state, run_topo, new_step, records
 
 
 def replay_topology_events(topo: Topology, schedule, plan, policy: str,
-                           run_seed: int, upto_round: int) -> Topology:
+                           run_seed: int, upto_round: int,
+                           quarantines=None) -> Topology:
     """Reconstruct the adjacency in force at a resume point.
 
     A checkpoint at round ``C`` reflects every event with ``r < C`` (the
@@ -312,19 +386,27 @@ def replay_topology_events(topo: Topology, schedule, plan, policy: str,
     :meth:`HostEvents.fire` batches them — reproduces the live topology
     sequence bitwise: explicit events are literal, churn and repair key
     their rngs per event round, and the CSR rebuilds are canonical.
+
+    ``quarantines`` maps a round to the node ids the sentinel quarantined
+    there (checkpoint ``quarantines`` metadata): dynamic kills a pure
+    replay could never re-derive, merged into the scheduled kills of
+    their round exactly as :meth:`HostEvents.quarantine` co-fired them.
     """
     from gossipprotocol_tpu.topology import repair as repair_mod
     from gossipprotocol_tpu.utils import faults as faults_mod
 
     repair_mod.validate_policy(policy)
     plan = plan_mod.as_plan(plan)
-    if policy == "off" and not plan.has_events:
+    quarantines = {int(r): np.asarray(v, np.int64)
+                   for r, v in dict(quarantines or {}).items()}
+    if policy == "off" and not plan.has_events and not quarantines:
         return topo
     birth = topo.birth_alive()
     alive = (np.ones(topo.num_nodes, bool) if birth is None
              else np.asarray(birth, bool).copy())
     rounds = set(schedule.kills) | set(schedule.revives)
     rounds |= set(plan.explicit_rounds())
+    rounds |= set(quarantines)
     if plan.churn is not None and upto_round > plan.churn.period:
         rounds |= set(range(int(plan.churn.period), int(upto_round),
                             int(plan.churn.period)))
@@ -333,6 +415,11 @@ def replay_topology_events(topo: Topology, schedule, plan, policy: str,
         if r >= upto_round:
             break
         kills = schedule.kills.get(r)
+        qids = quarantines.get(r)
+        if qids is not None:
+            kills = (qids if kills is None else
+                     np.unique(np.concatenate(
+                         [np.asarray(kills, np.int64), qids])))
         strikes = kills is not None
         if kills is not None:
             alive[np.asarray(kills, np.int64)] = False
@@ -356,4 +443,5 @@ def replay_topology(topo: Topology, cfg, upto_round: int) -> Topology:
     """Config-level wrapper over :func:`replay_topology_events` — the
     engines' resume entry point."""
     return replay_topology_events(
-        topo, cfg.schedule, cfg.events, cfg.repair, cfg.seed, upto_round)
+        topo, cfg.schedule, cfg.events, cfg.repair, cfg.seed, upto_round,
+        quarantines=dict(getattr(cfg, "quarantine_log", ()) or ()))

@@ -44,10 +44,17 @@ from gossipprotocol_tpu.topology.base import Topology, csr_from_edges
 
 CHURN_MODELS = ("edge", "swap")
 
+# Value-fault corruption models (``scale:K`` carries its factor inline).
+VALUE_FAULT_MODELS = ("nan", "inf", "stuck", "scale")
+
 # Domain-separation constant for the churn rng key (arbitrary, fixed
 # forever: part of the bitwise-replay contract, like repair's
 # _REWIRE_STREAM).
 _CHURN_STREAM = 0xC4BA9E
+
+# Domain-separation constant for value-fault node draws (fixed forever,
+# same contract as _CHURN_STREAM).
+_VALUEFAULT_STREAM = 0xFA017
 
 # Rejection-sampling budget per requested churn edge addition (a nearly
 # complete graph must not spin; a short add only costs event size, never
@@ -55,7 +62,7 @@ _CHURN_STREAM = 0xC4BA9E
 _ADD_DRAWS = 16
 
 _PLAN_KEYS = ("add_edges", "remove_edges", "swap_neighbors", "churn",
-              "kill", "revive", "loss")
+              "kill", "revive", "loss", "value_faults")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +100,81 @@ class ChurnSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class ValueFaultSpec:
+    """One seeded value-fault injection (``--value-faults
+    rate,model[,round]``).
+
+    At ``round`` a uniform-random sample of ``rate * n`` live nodes
+    (floor 1) has its push-sum numerator ``s`` corrupted:
+
+    * ``nan``     — payload becomes NaN (the classic silent poison);
+    * ``inf``     — payload becomes +Inf;
+    * ``stuck``   — payload resets to the node's initial value (a
+      learner that stopped learning but keeps gossiping);
+    * ``scale:K`` — payload multiplied by K (an adversarial or
+      miscalibrated contribution).
+
+    Node draws use a counter-based rng keyed on
+    ``(run_seed, round, _VALUEFAULT_STREAM)`` over *global* ids, so the
+    sample is identical across shard counts and resume replays. Dead
+    nodes are skipped at fire time — after a quarantine-and-rollback the
+    replayed injection lands on already-dead rows and is a no-op.
+    """
+
+    rate: float
+    model: str
+    round: int = 10
+
+    def validate(self) -> "ValueFaultSpec":
+        if not 0.0 < self.rate <= 1.0:
+            raise ValueError(
+                f"value-fault rate {self.rate} must be in (0, 1] — it is "
+                "the fraction of live nodes corrupted per event")
+        base = str(self.model).split(":", 1)[0]
+        if base not in VALUE_FAULT_MODELS:
+            raise ValueError(
+                f"value-fault model must be one of {VALUE_FAULT_MODELS} "
+                f"(scale as 'scale:K'), got {self.model!r}")
+        if base == "scale":
+            k = self.scale_factor()
+            if not np.isfinite(k) or k == 1.0:
+                raise ValueError(
+                    f"value-fault scale factor must be finite and != 1, "
+                    f"got {self.model!r}")
+        elif ":" in str(self.model):
+            raise ValueError(
+                f"value-fault model {self.model!r} takes no ':' argument")
+        if int(self.round) < 1:
+            raise ValueError(
+                f"value-fault round {self.round} must be >= 1")
+        return self
+
+    def scale_factor(self) -> float:
+        """The K of ``scale:K`` (ValueError for malformed specs)."""
+        parts = str(self.model).split(":", 1)
+        if parts[0] != "scale" or len(parts) != 2:
+            raise ValueError(f"not a scale model: {self.model!r}")
+        try:
+            return float(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"value-fault scale factor {parts[1]!r} is not a number")
+
+
+def value_fault_ids(num_nodes: int, spec: ValueFaultSpec, *,
+                    run_seed: int) -> np.ndarray:
+    """The global ids ``spec`` corrupts — a pure function of
+    ``(num_nodes, spec, run_seed)``, independent of shard count and of
+    everything the run did before the event round (the churn PRNG
+    discipline)."""
+    rng = np.random.default_rng(
+        [int(run_seed) & 0xFFFFFFFF, int(spec.round), _VALUEFAULT_STREAM])
+    k = min(num_nodes, max(1, int(round(spec.rate * num_nodes))))
+    return np.sort(rng.choice(num_nodes, size=k, replace=False)).astype(
+        np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
 class EventPlan:
     """Timed edge-level topology events + optional churn generator.
 
@@ -107,13 +189,14 @@ class EventPlan:
     removes: Mapping[int, np.ndarray] = dataclasses.field(default_factory=dict)
     swaps: Mapping[int, np.ndarray] = dataclasses.field(default_factory=dict)
     churn: Optional[ChurnSpec] = None
+    value_faults: Tuple[ValueFaultSpec, ...] = ()
 
     # ---- queries -------------------------------------------------------
 
     @property
     def has_events(self) -> bool:
         return (bool(self.adds) or bool(self.removes) or bool(self.swaps)
-                or self.churn is not None)
+                or self.churn is not None or bool(self.value_faults))
 
     def __bool__(self) -> bool:
         return self.has_events
@@ -152,6 +235,8 @@ class EventPlan:
                         f"for {num_nodes} nodes")
         if self.churn is not None:
             self.churn.validate()
+        for vf in self.value_faults:
+            vf.validate()
         return self
 
     # ---- construction --------------------------------------------------
@@ -163,13 +248,15 @@ class EventPlan:
         removes: Optional[Mapping[int, object]] = None,
         swaps: Optional[Mapping[int, object]] = None,
         churn: Optional[ChurnSpec] = None,
+        value_faults: Tuple[ValueFaultSpec, ...] = (),
     ) -> "EventPlan":
         norm = lambda ev, w: {  # noqa: E731
             int(r): np.asarray(arr, dtype=np.int64).reshape(-1, w)
             for r, arr in (ev or {}).items()
         }
         return cls(adds=norm(adds, 2), removes=norm(removes, 2),
-                   swaps=norm(swaps, 4), churn=churn)
+                   swaps=norm(swaps, 4), churn=churn,
+                   value_faults=tuple(value_faults))
 
     # ---- identity ------------------------------------------------------
 
@@ -194,8 +281,29 @@ class EventPlan:
                       [self.churn.rate, self.churn.model,
                        int(self.churn.period)]),
         }
+        if self.value_faults:
+            # Key present only when non-empty: fault-free plans keep
+            # their pre-existing digests byte-for-byte.
+            doc["value_faults"] = [[int(v.round), v.rate, str(v.model)]
+                                   for v in sorted(self.value_faults,
+                                                   key=lambda v: v.round)]
         blob = json.dumps(doc, sort_keys=True).encode()
         return hashlib.sha256(blob).hexdigest()[:16]
+
+    def value_fault_digest(self) -> str:
+        """Stable hash of just the value-fault portion — its own
+        checkpoint trajectory field (``"none"`` when the plan injects
+        nothing), so a resume under a different fault plan is refused
+        even when the topology-event portion matches."""
+        if not self.value_faults:
+            return "none"
+        doc = [[int(v.round), v.rate, str(v.model)]
+               for v in sorted(self.value_faults, key=lambda v: v.round)]
+        blob = json.dumps(doc, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def value_fault_rounds(self) -> Tuple[int, ...]:
+        return tuple(sorted({int(v.round) for v in self.value_faults}))
 
 
 _EMPTY_PLAN = EventPlan()
@@ -227,6 +335,28 @@ def parse_churn_arg(spec: str) -> ChurnSpec:
     return ChurnSpec(rate=rate, model=parts[1], period=period).validate()
 
 
+def parse_value_faults_arg(spec: str) -> ValueFaultSpec:
+    """``--value-faults RATE,MODEL[,ROUND]`` -> validated ValueFaultSpec."""
+    parts = [p.strip() for p in str(spec).split(",")]
+    if len(parts) not in (2, 3):
+        raise ValueError(
+            f"--value-faults wants RATE,MODEL[,ROUND], got {spec!r} "
+            f"(models: nan, inf, stuck, scale:K; round default 10)")
+    try:
+        rate = float(parts[0])
+    except ValueError:
+        raise ValueError(
+            f"--value-faults rate {parts[0]!r} is not a number")
+    rnd = 10
+    if len(parts) == 3:
+        try:
+            rnd = int(parts[2])
+        except ValueError:
+            raise ValueError(
+                f"--value-faults round {parts[2]!r} is not an int")
+    return ValueFaultSpec(rate=rate, model=parts[1], round=rnd).validate()
+
+
 def parse_event_plan(obj, num_nodes: Optional[int] = None, seed: int = 0):
     """Parse the ``--event-plan`` JSON document.
 
@@ -241,6 +371,7 @@ def parse_event_plan(obj, num_nodes: Optional[int] = None, seed: int = 0):
           "swap_neighbors": [{"round": 80,
                               "pairs": [[[0, 1], [2, 3]]]}],
           "churn":          {"rate": 0.02, "model": "edge", "period": 25},
+          "value_faults":   [{"round": 12, "rate": 0.05, "model": "nan"}],
           "kill":   [{"round": 10, "ids": [1, 2]}],
           "revive": [{"round": 30, "ids": [1, 2]}],
           "loss":   [{"start": 5, "stop": 25, "prob": 0.2}]
@@ -299,6 +430,25 @@ def parse_event_plan(obj, num_nodes: Optional[int] = None, seed: int = 0):
             out[r] = arr if prev is None else np.concatenate([prev, arr])
         return out
 
+    value_faults = []
+    if "value_faults" in obj:
+        entries = obj["value_faults"]
+        if not isinstance(entries, (list, tuple)):
+            raise ValueError("value_faults must be a list of events")
+        for ev in entries:
+            if (not isinstance(ev, dict) or "rate" not in ev
+                    or "model" not in ev):
+                raise ValueError(
+                    "value_faults: each event needs 'rate' and 'model' "
+                    "(optional 'round')")
+            extra = set(ev) - {"rate", "model", "round"}
+            if extra:
+                raise ValueError(
+                    f"value_faults: unknown key(s) {sorted(extra)}")
+            value_faults.append(ValueFaultSpec(
+                rate=float(ev["rate"]), model=str(ev["model"]),
+                round=int(ev.get("round", 10))).validate())
+
     churn = None
     if "churn" in obj:
         c = obj["churn"]
@@ -317,6 +467,7 @@ def parse_event_plan(obj, num_nodes: Optional[int] = None, seed: int = 0):
         removes=edge_events("remove_edges"),
         swaps=edge_events("swap_neighbors"),
         churn=churn,
+        value_faults=tuple(value_faults),
     ).validate(num_nodes)
     sched = faults.FaultSchedule.from_json(
         {k: obj[k] for k in ("kill", "revive", "loss") if k in obj},

@@ -73,9 +73,18 @@ def _record_flags(manifest: Optional[Dict[str, Any]],
     wdrift = (manifest or {}).get("max_w_drift_ulps")
     # a lossy --payload-wire deliberately rounds edge shares on the
     # sharded exchange, so drift there is the documented cost of the
-    # knob, not an anomaly — same gating as churn on the counter rule
+    # knob, not an anomaly — same gating as churn on the counter rule.
+    # Likewise a value-fault/quarantine run: the injected corruption and
+    # the containment kill both displace mass by construction, which is
+    # the sentinel's story (its own rule below), not honest drift
     wire = (manifest or {}).get("config", {}).get("payload_wire", "f32")
-    if (drift is not None and wire == "f32"
+    displaced = (
+        ((manifest or {}).get("config", {}).get("event_plan") or {})
+        .get("value_fault_events", 0) > 0
+        or any(r.get("event") in ("sentinel_trip", "quarantine")
+               for r in metrics)
+    )
+    if (drift is not None and wire == "f32" and not displaced
             and max(drift, wdrift or 0.0) > DRIFT_ULP_TOL):
         flags.append(
             f"push-sum mass drift up to {max(drift, wdrift or 0.0):.0f} ULPs "
@@ -103,11 +112,15 @@ def _counter_flags(manifest: Optional[Dict[str, Any]]) -> List[str]:
     has_events = (plan.get("add_events", 0) > 0
                   or plan.get("remove_events", 0) > 0
                   or plan.get("swap_events", 0) > 0
-                  or plan.get("churn") is not None)
+                  or plan.get("churn") is not None
+                  or plan.get("value_fault_events", 0) > 0)
+    quarantined = ((manifest.get("sentinel") or {})
+                   .get("quarantine_events", 0) > 0)
     if (not counters
             or cfg.get("algorithm") != "push-sum"
             or sched.get("kill_events", 0) > 0
-            or has_events):
+            or has_events
+            or quarantined):
         return []
     sent = int(counters.get("sent", 0))
     delivered = int(counters.get("delivered", 0))
@@ -164,6 +177,27 @@ def _sweep_flags(manifest: Optional[Dict[str, Any]]) -> List[str]:
             f"round budget{detail}"
         ]
     return []
+
+
+def _sentinel_flags(manifest: Optional[Dict[str, Any]],
+                    metrics: List[Dict[str, Any]]) -> List[str]:
+    """Health-sentinel rule: a trip the run did NOT recover from is an
+    anomaly. A trip that was contained (quarantine/rollback) on a run
+    that then converged is the sentinel doing its job — the report's
+    quarantine section tells that story, and the chaos-smoke CI contract
+    (converged containment run => ``anomalies: none``) stays intact."""
+    trips = [r for r in metrics if r.get("event") == "sentinel_trip"]
+    if not trips:
+        return []
+    result = (manifest or {}).get("result")
+    if result is not None and result.get("converged", False):
+        return []
+    last = trips[-1]
+    return [
+        f"sentinel TRIPPED at round {last.get('round', '?')} "
+        f"({last.get('cause', '?')}, {last.get('nodes', '?')} node(s), "
+        f"mode {last.get('mode', '?')}) and the run did not recover"
+    ]
 
 
 def _budget_flags(manifest: Optional[Dict[str, Any]],
@@ -246,6 +280,7 @@ def anomaly_flags(
     flags += _sweep_flags(manifest)
     flags += _counter_flags(manifest)
     flags += _shard_flags(manifest)
+    flags += _sentinel_flags(manifest, metrics)
     flags += _budget_flags(manifest, metrics)
     flags += _trace_flags(manifest, trace)
     if manifest is None:

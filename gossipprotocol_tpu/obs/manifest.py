@@ -25,7 +25,8 @@ from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
 # telemetry hub itself) or are captured in richer form elsewhere
 # ("sweep" lands as the top-level sweep rollup with per-lane records)
 _SKIP_CONFIG_FIELDS = ("metrics_callback", "telemetry", "fault_schedule",
-                       "fault_plan", "event_plan", "sweep")
+                       "fault_plan", "event_plan", "sweep",
+                       "quarantine_log")
 
 
 def config_doc(cfg) -> Dict[str, Any]:
@@ -59,6 +60,10 @@ def config_doc(cfg) -> Dict[str, Any]:
         "churn": (None if plan.churn is None else
                   {"rate": plan.churn.rate, "model": plan.churn.model,
                    "period": int(plan.churn.period)}),
+        # value-fault injections: count + the same digest the checkpoint
+        # trajectory metadata pins ("none" when the plan has no faults)
+        "value_fault_events": len(plan.value_faults),
+        "value_faults": plan.value_fault_digest(),
     }
     return doc
 
@@ -134,6 +139,23 @@ def build_manifest(
                       if getattr(tel, "resources_on", False)
                       and tel.dir is not None else None),
     }
+    # sentinel rollup: trip/quarantine counts from the run's own metric
+    # records (None when the sentinel was off — healthy manifests stay
+    # byte-stable modulo this one null key)
+    if getattr(cfg, "sentinel", "off") != "off":
+        recs = result.metrics if result is not None else []
+        quars = [m for m in recs if m.get("event") == "quarantine"]
+        doc["sentinel"] = {
+            "mode": cfg.sentinel,
+            "trips": sum(1 for m in recs
+                         if m.get("event") == "sentinel_trip"),
+            "rollbacks": sum(1 for m in recs
+                             if m.get("event") == "rollback"),
+            "quarantine_events": len(quars),
+            "quarantined_nodes": sum(int(m.get("nodes", 0)) for m in quars),
+        }
+    else:
+        doc["sentinel"] = None
     if result is not None:
         err = result.estimate_error
         doc["result"] = {

@@ -405,6 +405,35 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
             f" ~{swapped} swapped, {skipped} skipped\n"
         )
 
+    # data-fault sentinel (value faults, trips, quarantines) --------------
+    vf_recs = [r for r in metrics if r.get("event") == "value_fault"]
+    for r in vf_recs:
+        out.write(
+            f"\nvalue fault injected: {int(r.get('nodes', 0))} node(s) at "
+            f"round {r.get('round', '?')} (model {r.get('model', '?')}, "
+            f"rate {r.get('rate', '?')})\n"
+        )
+    trip_recs = [r for r in metrics if r.get("event") == "sentinel_trip"]
+    for r in trip_recs:
+        out.write(
+            f"sentinel trip: {r.get('cause', '?')} on "
+            f"{int(r.get('nodes', 0))} node(s) at round "
+            f"{r.get('round', '?')} (mode {r.get('mode', '?')})\n"
+        )
+    for r in metrics:
+        if r.get("event") == "rollback":
+            out.write(
+                f"rollback: restored round {r.get('round', '?')} from "
+                f"round {r.get('from_round', '?')} "
+                f"({os.path.basename(str(r.get('checkpoint', '?')))})\n"
+            )
+    quar_recs = [r for r in metrics if r.get("event") == "quarantine"]
+    for r in quar_recs:
+        out.write(
+            f"quarantined: {int(r.get('nodes', 0))} node(s) at round "
+            f"{r.get('round', '?')} (repair {r.get('policy', '?')})\n"
+        )
+
     # anomalies ----------------------------------------------------------
     flags = anomaly_flags(manifest, metrics, trace)
     if flags:

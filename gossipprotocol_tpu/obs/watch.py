@@ -78,6 +78,20 @@ def _frame(data: Dict[str, Any], out: TextIO) -> bool:
     if residuals:
         out.write(
             f"residual  {sparkline(residuals)}  {residuals[-1]:.3e}\n")
+    # live sentinel status: trips/quarantines the partial record already
+    # shows (the manifest rollup only lands when the run finishes)
+    trips = [r for r in metrics if r.get("event") == "sentinel_trip"]
+    quars = [r for r in metrics if r.get("event") == "quarantine"]
+    if trips or quars:
+        last_t = trips[-1] if trips else {}
+        qn = sum(int(r.get("nodes", 0)) for r in quars)
+        out.write(
+            f"sentinel  {len(trips)} trip(s)"
+            + (f", last {last_t.get('cause', '?')} at round "
+               f"{last_t.get('round', '?')}" if trips else "")
+            + (f"; quarantined {qn} node(s)" if quars else "")
+            + "\n"
+        )
     counters = (manifest or {}).get("counters")
     if counters:
         out.write(

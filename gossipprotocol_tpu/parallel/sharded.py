@@ -365,6 +365,7 @@ def make_sharded_chunk_runner(
         platform=platform,
     )
     is_pushsum = cfg.algorithm != "gossip"
+    sentinel_on = cfg.sentinel != "off"
     routed = (is_pushsum and cfg.fanout == "all"
               and cfg.delivery in ("routed", "pallas"))
     if hasattr(topo, "csr_slice"):
@@ -502,18 +503,36 @@ def make_sharded_chunk_runner(
                 unconv = jnp.sum((~s.converged & s.alive).astype(jnp.int32))
                 return jax.lax.psum(unconv, NODES_AXIS) == 0
 
+        if sentinel_on:
+            # the loop stops when any shard holds a sick row: psum the
+            # local any() so every shard exits the same iteration (the
+            # cond must agree collectively, like global_done itself).
+            # Off leaves loop_stop as the literal global_done function
+            # object — the traced program is byte-identical (the goldens
+            # pin it).
+            from gossipprotocol_tpu.engine.driver import sentinel_bad_mask
+
+            def global_trip(s):
+                bad = jnp.any(sentinel_bad_mask(s)).astype(jnp.int32)
+                return jax.lax.psum(bad, NODES_AXIS) > 0
+
+            def loop_stop(s):
+                return jnp.logical_or(global_done(s), global_trip(s))
+        else:
+            loop_stop = global_done
+
         if counter_fn is None and trace_fn is None:
             def body(carry):
                 s, _ = carry
                 s = round_fn(s)
-                return s, global_done(s)
+                return s, loop_stop(s)
 
             def cond(carry):
                 s, done = carry
                 return jnp.logical_and(~done, s.round < round_limit)
 
             final, done = jax.lax.while_loop(
-                cond, body, (state_l, global_done(state_l))
+                cond, body, (state_l, loop_stop(state_l))
             )
             buf = None
             sbuf = None
@@ -548,7 +567,7 @@ def make_sharded_chunk_runner(
                     bufs["trace"],
                     trace_fn(s2).astype(jnp.float32)[None, :],
                     (row, jnp.int32(0)))
-                return s2, global_done(s2), bufs
+                return s2, loop_stop(s2), bufs
 
             def cond(carry):
                 s, done, _ = carry
@@ -562,7 +581,7 @@ def make_sharded_chunk_runner(
                     bufs0["shard_counters"] = jnp.zeros(
                         (counter_slots, 3), jnp.int32)
             final, done, bufs = jax.lax.while_loop(
-                cond, body, (state_l, global_done(state_l), bufs0)
+                cond, body, (state_l, loop_stop(state_l), bufs0)
             )
             buf = bufs.get("counters")
             sbuf = bufs.get("shard_counters")
@@ -588,7 +607,7 @@ def make_sharded_chunk_runner(
                 bufs["shard_counters"] = jax.lax.dynamic_update_slice(
                     bufs["shard_counters"], raw[None, :],
                     (row, jnp.int32(0)))
-                return s2, global_done(s2), bufs
+                return s2, loop_stop(s2), bufs
 
             def cond(carry):
                 s, done, _ = carry
@@ -599,7 +618,7 @@ def make_sharded_chunk_runner(
                 "shard_counters": jnp.zeros((counter_slots, 3), jnp.int32),
             }
             final, done, bufs = jax.lax.while_loop(
-                cond, body, (state_l, global_done(state_l), bufs0)
+                cond, body, (state_l, loop_stop(state_l), bufs0)
             )
             buf = bufs["counters"]
             sbuf = bufs["shard_counters"]
@@ -622,7 +641,7 @@ def make_sharded_chunk_runner(
                 )
                 buf = jax.lax.dynamic_update_slice(
                     buf, delta[None, :], (s.round - start, jnp.int32(0)))
-                return s2, global_done(s2), buf
+                return s2, loop_stop(s2), buf
 
             def cond(carry):
                 s, done, _ = carry
@@ -630,7 +649,7 @@ def make_sharded_chunk_runner(
 
             buf0 = jnp.zeros((counter_slots, 3), jnp.int32)
             final, done, buf = jax.lax.while_loop(
-                cond, body, (state_l, global_done(state_l), buf0)
+                cond, body, (state_l, loop_stop(state_l), buf0)
             )
             sbuf = None
             trace_buf = None
@@ -680,6 +699,18 @@ def make_sharded_chunk_runner(
             stats.update(mass_stats(final, all_sum=psum_all))
         if trace_buf is not None:
             stats["trace"] = trace_buf  # psum/pmax-replicated per round
+        if sentinel_on:
+            # the carried flag is loop_stop (done | trip): report real
+            # convergence separately, and surface the trip so the host
+            # can attribute rows at the chunk boundary. Mass scalars
+            # feed the host ULP tripwire — dedup with the counter path.
+            stats["done"] = global_done(final)
+            stats["sentinel_trip"] = jax.lax.psum(
+                jnp.any(sentinel_bad_mask(final)).astype(jnp.int32),
+                NODES_AXIS,
+            )
+            if "mass_s" not in stats:
+                stats.update(mass_stats(final, all_sum=psum_all))
         return final, stats
 
     specs = _state_specs(state0)
@@ -772,6 +803,11 @@ def make_sharded_chunk_runner(
             stats_fields += ["mass_s", "mass_w"]
     if trace_fn is not None:
         stats_fields += ["trace"]
+    if sentinel_on:
+        stats_fields += ["sentinel_trip"]
+        if (is_pushsum and cfg.workload not in ("sgp", "gala")
+                and "mass_s" not in stats_fields):
+            stats_fields += ["mass_s", "mass_w"]
     stats_specs = {k: P() for k in stats_fields}
     if attribution:
         # the one unreduced stat: per-shard [slots, 3] partials gathered
@@ -877,6 +913,14 @@ def run_simulation_sharded(
                 "event/repair schedules rewrite the global adjacency, "
                 "which a streamed build never materializes — use "
                 "--build materialized with event plans")
+        if cfg.sentinel in ("quarantine", "rollback"):
+            # quarantine fires a synthetic kill through the same engine
+            # (partition rule + optional repair need the global CSR)
+            raise ValueError(
+                "sentinel quarantine/rollback kills nodes through the "
+                "event engine, which needs the global adjacency a "
+                "streamed build never materializes — use --build "
+                "materialized, or --sentinel on for detection only")
         if topo.num_shards != num_shards:
             # checked before the routed-push plan pre-build below, which
             # would otherwise fail on a misaligned csr_slice request
@@ -888,7 +932,7 @@ def run_simulation_sharded(
     from gossipprotocol_tpu.engine.driver import resume_allows_fast
 
     run_topo = topo
-    if (cfg.repair != "off" or cfg.events.has_events) \
+    if (cfg.repair != "off" or cfg.events.has_events or cfg.quarantine_log) \
             and initial_state is not None:
         # same replay the single-chip engine does: the resumed run must
         # continue on the adjacency the checkpoint lived through (repair
@@ -1017,6 +1061,13 @@ def run_simulation_sharded(
         cur["topo"], cur["plans"] = new_topo, nbrs_over if routed_push else None
         return step2, st, info
 
+    def reload_fn(st):
+        # rollback re-materialization: same copy-then-place discipline as
+        # the resume path above (the runner donates its inputs)
+        owned = jax.tree.map(np.array, pad_state(st, n_padded))
+        return jax.device_put(owned, shardings)
+
     return _drive(topo, cfg, state, step, done_fn, compile_ms, trim=trim,
                   rebuild=rebuild, run_topo=run_topo,
-                  prediction=compute_prediction(run_topo, cfg, tel))
+                  prediction=compute_prediction(run_topo, cfg, tel),
+                  reload_fn=reload_fn)

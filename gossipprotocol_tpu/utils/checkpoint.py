@@ -81,6 +81,15 @@ TRAJECTORY_FIELDS = (
     # is deliberately NOT here: it moves identical bytes in an identical
     # order, bitwise-equal to the start-all-then-wait transport.
     "rounds_per_kernel", "payload_wire",
+    # seeded value-fault injections (events/plan.py) corrupt protocol
+    # state at their rounds: resuming under a different fault plan would
+    # splice two different corruption histories. Stored as the plan's
+    # dedicated value-fault digest ("none" for injection-free runs), so
+    # it pins independently of the topology-event portion. The sentinel
+    # mode itself is deliberately NOT a trajectory field: like telemetry
+    # it only observes (quarantines it *performs* are recorded per-
+    # checkpoint in the "quarantines" metadata and replayed from there).
+    "value_faults",
 )
 
 
@@ -113,7 +122,10 @@ LEGACY_FIELD_DEFAULTS = {"fanout": "one", "delivery": "scatter",
                          # pre-megakernel checkpoints ran one round per
                          # kernel on the uncompressed f32 wire — the only
                          # behavior that existed
-                         "rounds_per_kernel": 1, "payload_wire": "f32"}
+                         "rounds_per_kernel": 1, "payload_wire": "f32",
+                         # pre-sentinel checkpoints never injected value
+                         # faults (the knob did not exist)
+                         "value_faults": "none"}
 
 # Sentinel written for alert_quorum=None (the all-nodes stop rule). None
 # cannot be stored raw: resume validation could not tell "all-nodes run"
@@ -189,8 +201,10 @@ def trajectory_meta(cfg) -> dict:
     # likewise the event plan: its digest, "none" for plan-free runs
     from gossipprotocol_tpu.events import plan as events_plan
 
-    meta["event_plan"] = events_plan.as_plan(
-        getattr(cfg, "event_plan", None)).digest()
+    plan = events_plan.as_plan(getattr(cfg, "event_plan", None))
+    meta["event_plan"] = plan.digest()
+    # the value-fault portion pins separately (see TRAJECTORY_FIELDS)
+    meta["value_faults"] = plan.value_fault_digest()
     return meta
 
 
@@ -234,12 +248,17 @@ def fetch_host(state):
 
 
 def save(
-    directory: str, state, cfg, topo_kind: str, adjacency: str | None = None
+    directory: str, state, cfg, topo_kind: str, adjacency: str | None = None,
+    extra_meta: dict | None = None
 ) -> str:
     """Write ``state`` to ``directory/ckpt_round{R}.npz``; returns the path.
 
     ``adjacency``: :func:`topology_fingerprint` of the run's graph (the
     driver computes it once per run, not per checkpoint).
+
+    ``extra_meta``: additional JSON-able metadata (the drive loop records
+    sentinel quarantines here — dynamic kills a resume replay could not
+    re-derive from the declarative plan).
     """
     os.makedirs(directory, exist_ok=True)
     # fetch_host is a collective under jax.distributed — every process must
@@ -253,6 +272,7 @@ def save(
         "topology": topo_kind,
         "adjacency": adjacency,
         "saved_at": time.time(),
+        **(extra_meta or {}),
         **trajectory_meta(cfg),
     }
     path = os.path.join(directory, f"ckpt_round{meta['round']:09d}.npz")

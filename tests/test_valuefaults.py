@@ -1,0 +1,481 @@
+"""Data-fault tolerance (ISSUE 17): seeded value-fault injection, the
+on-device health sentinel, and quarantine-and-rollback containment.
+
+The claims pinned here:
+
+* injection draws are a pure function of ``(num_nodes, spec, run_seed)``
+  — identical across shard counts and resume replays (the churn PRNG
+  discipline, now for data faults);
+* the sentinel is zero-cost off: a value-fault plan never changes the
+  compiled chunk program, and ``sentinel='off'`` lowers to the literal
+  pre-sentinel program the chunk-program goldens capture;
+* the containment contract pair: the same poison that NaNs the whole
+  network with the sentinel off converges to the honest-subset mean
+  under ``--sentinel quarantine --repair rewire``;
+* rollback restores the newest checkpoint predating the trip, replays
+  with the quarantine inserted, and the whole pipeline is deterministic
+  (bitwise-identical across reruns) and resumable mid-quarantine;
+* quarantine decisions are sharding-invariant (2/4/8 shards pick the
+  same offenders and dead sets);
+* the CLI refuses every invalid spelling loudly (exit-2 matrix), and a
+  resume under a different fault plan is refused via the checkpoint's
+  ``value_faults`` trajectory field.
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gossipprotocol_tpu import RunConfig, build_topology, run_simulation
+from gossipprotocol_tpu.events import (
+    EventPlan,
+    ValueFaultSpec,
+    parse_event_plan,
+    parse_value_faults_arg,
+    value_fault_ids,
+)
+from gossipprotocol_tpu.cli import main as cli_main
+from gossipprotocol_tpu.parallel import run_simulation_sharded
+from gossipprotocol_tpu.utils import checkpoint as ckpt
+
+
+def run_cli(args, capsys):
+    code = cli_main(args)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "golden", "chunk_programs.json"
+)
+
+# the canonical chaos plan used throughout: poison 5% of 64 nodes (3
+# rows) with NaN at round 5
+_SPEC = ValueFaultSpec(rate=0.05, model="nan", round=5)
+_PLAN = EventPlan(value_faults=(_SPEC,))
+
+
+def _cfg(**kw):
+    base = dict(seed=7, algorithm="push-sum", event_plan=_PLAN,
+                max_rounds=200)
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _recs(result, event):
+    return [m for m in result.metrics if m.get("event") == event]
+
+
+# ----------------------------------------------------- injection draws
+
+
+def test_value_fault_ids_pure_and_shard_invariant():
+    """The sample is a pure function of (n, spec, seed): stable across
+    calls (so every shard and every resume replay draws the same rows),
+    sensitive to seed and round, sized ``max(1, round(rate*n))``."""
+    a = value_fault_ids(1024, _SPEC, run_seed=7)
+    b = value_fault_ids(1024, _SPEC, run_seed=7)
+    assert np.array_equal(a, b)
+    assert a.size == round(0.05 * 1024)
+    assert np.array_equal(a, np.sort(a)) and np.unique(a).size == a.size
+    assert a.min() >= 0 and a.max() < 1024
+    assert not np.array_equal(a, value_fault_ids(1024, _SPEC, run_seed=8))
+    assert not np.array_equal(
+        a, value_fault_ids(1024, dataclasses.replace(_SPEC, round=6),
+                           run_seed=7))
+    # floor 1: a tiny rate on a tiny graph still corrupts one node
+    assert value_fault_ids(16, ValueFaultSpec(rate=0.01, model="inf"),
+                           run_seed=0).size == 1
+
+
+def test_parse_value_faults_arg():
+    vf = parse_value_faults_arg("0.05,nan")
+    assert (vf.rate, vf.model, vf.round) == (0.05, "nan", 10)
+    vf = parse_value_faults_arg("0.1,scale:2.5,20")
+    assert (vf.model, vf.round) == ("scale:2.5", 20)
+    with pytest.raises(ValueError, match=r"in \(0, 1\]"):
+        parse_value_faults_arg("2,nan")
+    with pytest.raises(ValueError, match="must be one of"):
+        parse_value_faults_arg("0.1,bogus")
+    with pytest.raises(ValueError, match="is not a number"):
+        parse_value_faults_arg("x,nan")
+    with pytest.raises(ValueError, match="is not an int"):
+        parse_value_faults_arg("0.1,nan,soon")
+
+
+def test_value_fault_digest_is_a_trajectory_field():
+    """The fault plan is part of the trajectory identity: same plan ->
+    same digest, different plan -> different digest, empty -> 'none',
+    and the checkpoint metadata carries it."""
+    assert "value_faults" in ckpt.TRAJECTORY_FIELDS
+    assert EventPlan().value_fault_digest() == "none"
+    d = _PLAN.value_fault_digest()
+    assert d == EventPlan(value_faults=(_SPEC,)).value_fault_digest()
+    assert d != EventPlan(value_faults=(
+        dataclasses.replace(_SPEC, model="inf"),)).value_fault_digest()
+    meta = ckpt.trajectory_meta(_cfg())
+    assert meta["value_faults"] == d
+    assert ckpt.trajectory_meta(RunConfig(
+        algorithm="push-sum"))["value_faults"] == "none"
+
+
+def test_event_plan_json_value_faults_roundtrip():
+    plan, _ = parse_event_plan(
+        {"value_faults": [{"round": 12, "rate": 0.05, "model": "nan"}]},
+        num_nodes=64)
+    assert plan.value_faults == (
+        ValueFaultSpec(rate=0.05, model="nan", round=12),)
+    assert plan.has_events
+    with pytest.raises(ValueError, match="needs 'rate' and 'model'"):
+        parse_event_plan({"value_faults": [{"round": 12}]}, num_nodes=64)
+
+
+# ----------------------------------------------------- zero-cost off
+
+
+def _lowered(cfg) -> str:
+    from gossipprotocol_tpu.engine.driver import (
+        build_protocol,
+        device_arrays,
+        make_chunk_runner,
+        make_sentinel_fn,
+    )
+
+    topo = build_topology("line", 32)
+    state, core, done_fn, extra, _ = build_protocol(topo, cfg)
+    nbrs = device_arrays(topo, cfg)
+    slots = cfg.resolve_chunk_rounds(32, int(topo.indices.size))
+    sentinel_fn = make_sentinel_fn(cfg) if cfg.sentinel != "off" else None
+    runner = make_chunk_runner(core, done_fn, extra, counter_fn=None,
+                               counter_slots=slots, sentinel_fn=sentinel_fn)
+    return runner.lower(
+        state, nbrs, jax.random.key(0), jnp.int32(0)
+    ).as_text()
+
+
+def test_sentinel_off_is_zero_cost():
+    """With the sentinel off the chunk program is byte-identical to the
+    pre-sentinel program — even with a value-fault plan configured (the
+    injection is a host-side chunk-boundary event, invisible to XLA).
+    With the sentinel on, the program genuinely changes (the gate is
+    real, not dead code)."""
+    plain = _lowered(RunConfig(seed=0, algorithm="push-sum"))
+    with_plan = _lowered(RunConfig(seed=0, algorithm="push-sum",
+                                   event_plan=_PLAN))
+    assert plain == with_plan
+    armed = _lowered(RunConfig(seed=0, algorithm="push-sum",
+                               sentinel="on"))
+    assert armed != plain
+    # the off program is the literal golden the observatory pins
+    if not os.path.isfile(GOLDEN_PATH):
+        pytest.skip("no golden capture")
+    with open(GOLDEN_PATH) as fh:
+        golden = json.load(fh)
+    if golden.get("jax_version") != jax.__version__:
+        pytest.skip("golden captured on a different jax version")
+    assert (hashlib.sha256(plain.encode()).hexdigest()
+            == golden["digests"]["pushsum_one_1chip_off"])
+
+
+# ----------------------------------------------------- containment contract
+
+
+def test_contract_pair_poison_vs_quarantine():
+    """The load-bearing pair: sentinel off, three NaN rows poison the
+    whole network (push-sum dutifully averages the poison in); sentinel
+    'quarantine' + rewire repair cuts them out at the next chunk
+    boundary and the survivors converge to the honest-subset mean."""
+    topo = build_topology("imp3D", 64)
+    poisoned = run_simulation(topo, _cfg(max_rounds=60))
+    assert poisoned.estimate_error is not None
+    assert not np.isfinite(poisoned.estimate_error)
+    assert not poisoned.converged
+
+    saved = run_simulation(
+        topo, _cfg(sentinel="quarantine", repair="rewire"))
+    assert saved.converged
+    assert saved.estimate_error < 1e-6
+    trips = _recs(saved, "sentinel_trip")
+    quars = _recs(saved, "quarantine")
+    assert trips and trips[0]["cause"] == "nonfinite"
+    assert len(quars) == 1
+    expected = value_fault_ids(64, _SPEC, run_seed=7)
+    assert quars[0]["ids"] == expected.tolist()
+    assert quars[0]["policy"] == "rewire"
+    # the published final state is clean: no NaN survives containment
+    fin = ckpt.fetch_host(saved.final_state)
+    assert np.isfinite(np.asarray(fin.s)).all()
+
+
+def test_sentinel_on_detects_and_stops():
+    """Detect-only mode: the loop condition trips the moment a sick row
+    exists (before the poison spreads a single round) and the drive loop
+    stops — no quarantine, no rollback, just the trip record."""
+    res = run_simulation(build_topology("imp3D", 64), _cfg(sentinel="on"))
+    assert not res.converged
+    trips = _recs(res, "sentinel_trip")
+    assert len(trips) == 1
+    assert trips[0]["cause"] == "nonfinite"
+    assert trips[0]["nodes"] == value_fault_ids(64, _SPEC, run_seed=7).size
+    assert not _recs(res, "quarantine")
+    # stopped at the trip, not at max_rounds
+    assert res.rounds <= trips[0]["round"] + 1
+
+
+# ----------------------------------------------------- rollback
+
+
+def test_rollback_restores_predating_checkpoint(tmp_path):
+    """sentinel='rollback': restore the newest checkpoint strictly
+    predating the trip, replay with the quarantine inserted, converge.
+    chunk_rounds=4 + checkpoint_every=1 guarantees a clean pre-fault
+    checkpoint exists (saves land at chunk boundaries, faults at 5)."""
+    topo = build_topology("imp3D", 64)
+    cfg = _cfg(sentinel="rollback", repair="rewire", chunk_rounds=4,
+               checkpoint_every=1, checkpoint_dir=str(tmp_path / "ck"))
+    res = run_simulation(topo, cfg)
+    assert res.converged and res.estimate_error < 1e-6
+    rbs = _recs(res, "rollback")
+    assert len(rbs) == 1
+    assert rbs[0]["round"] < rbs[0]["from_round"]
+    assert rbs[0]["from_round"] >= _SPEC.round
+    quars = _recs(res, "quarantine")
+    assert quars and quars[0]["ids"] == value_fault_ids(
+        64, _SPEC, run_seed=7).tolist()
+    assert not _recs(res, "rollback_fallback")
+
+    # determinism: the whole trip->restore->replay pipeline reruns
+    # bitwise-identically
+    cfg2 = dataclasses.replace(cfg, checkpoint_dir=str(tmp_path / "ck2"))
+    res2 = run_simulation(topo, cfg2)
+    assert res2.rounds == res.rounds
+    a, b = ckpt.fetch_host(res.final_state), ckpt.fetch_host(res2.final_state)
+    assert np.array_equal(np.asarray(a.s), np.asarray(b.s), equal_nan=True)
+    assert np.array_equal(np.asarray(a.w), np.asarray(b.w), equal_nan=True)
+
+
+def test_rollback_without_predating_checkpoint_falls_back(tmp_path):
+    """A trip in the first chunk has nothing to restore — containment
+    degrades to in-place quarantine with a loud fallback record instead
+    of dying or silently detecting-only."""
+    res = run_simulation(
+        build_topology("imp3D", 64),
+        _cfg(sentinel="rollback", repair="rewire",
+             checkpoint_every=1, checkpoint_dir=str(tmp_path / "ck")))
+    assert res.converged
+    fbs = _recs(res, "rollback_fallback")
+    assert fbs and "no checkpoint predates" in fbs[0]["reason"]
+    assert not _recs(res, "rollback")
+    assert _recs(res, "quarantine")
+
+
+def test_mid_quarantine_resume_is_bitwise(tmp_path):
+    """Resuming from a checkpoint taken AFTER the quarantine must land on
+    the same graph and dead set (the checkpoint's quarantine log replays
+    into the topology reconstruction) and continue bitwise."""
+    topo = build_topology("imp3D", 64)
+    cfg = _cfg(sentinel="quarantine", repair="rewire", chunk_rounds=4,
+               checkpoint_every=1, checkpoint_dir=str(tmp_path / "ck"))
+    full = run_simulation(topo, cfg)
+    assert full.converged
+
+    # newest checkpoint that already lived through the quarantine but
+    # predates the finish — the awkward middle a recovery really hits
+    target = meta = None
+    for path in ckpt.candidates(str(tmp_path / "ck")):
+        m = ckpt.peek_meta(path)
+        if m.get("quarantines") and m["round"] < full.rounds:
+            target, meta = path, m
+            break
+    assert target is not None, "no mid-quarantine checkpoint published"
+    assert meta["quarantines"] == [[_recs(full, "quarantine")[0]["round"],
+                                    value_fault_ids(64, _SPEC,
+                                                    run_seed=7).tolist()]]
+
+    state, meta = ckpt.load(target)
+    cfg2 = dataclasses.replace(
+        cfg, checkpoint_dir=None, checkpoint_every=0,
+        quarantine_log=tuple((int(r), tuple(int(i) for i in ids))
+                             for r, ids in meta["quarantines"]))
+    res = run_simulation(topo, cfg2, initial_state=state)
+    assert res.converged and res.rounds == full.rounds
+    a, b = ckpt.fetch_host(full.final_state), ckpt.fetch_host(res.final_state)
+    assert np.array_equal(np.asarray(a.s), np.asarray(b.s))
+    assert np.array_equal(np.asarray(a.w), np.asarray(b.w))
+    assert np.array_equal(np.asarray(a.alive), np.asarray(b.alive))
+
+
+# ----------------------------------------------------- sharding invariance
+
+
+def test_quarantine_shard_invariant_2_4_8():
+    """The trip fires the same chunk and quarantines the same global ids
+    at every shard count; the surviving dead sets are bitwise equal."""
+    topo = build_topology("imp3D", 64)
+    cfg = _cfg(sentinel="quarantine", repair="rewire")
+    expected = value_fault_ids(64, _SPEC, run_seed=7).tolist()
+    alive_sets = []
+    for nd in (2, 4, 8):
+        res = run_simulation_sharded(topo, cfg, num_devices=nd)
+        assert res.converged, f"{nd} shards did not converge"
+        assert res.estimate_error < 1e-6
+        quars = _recs(res, "quarantine")
+        assert len(quars) == 1, f"{nd} shards: {quars}"
+        assert quars[0]["ids"] == expected
+        alive = np.asarray(
+            jax.device_get(res.final_state.alive))[:topo.num_nodes]
+        alive_sets.append(alive)
+    assert np.array_equal(alive_sets[0], alive_sets[1])
+    assert np.array_equal(alive_sets[0], alive_sets[2])
+
+
+# ----------------------------------------------------- CLI surface
+
+
+def test_cli_value_fault_exit2_matrix(capsys):
+    """Every invalid spelling is a clean input error with a reasoned
+    message, not a traceback."""
+    cases = [
+        (["64", "imp3D", "gossip", "--value-faults", "0.05,nan"],
+         "gossip carries no numeric mass"),
+        (["64", "imp3D", "push-sum", "--value-faults", "2,nan"],
+         "must be in (0, 1]"),
+        (["64", "imp3D", "push-sum", "--value-faults", "0.05,bogus"],
+         "must be one of"),
+        (["64", "imp3D", "push-sum", "--value-faults", "0.05,nan",
+          "--sentinel", "rollback"],
+         "requires checkpoint_every AND checkpoint_dir"),
+        (["64", "imp3D", "push-sum", "--sentinel", "--semantics",
+          "reference"],
+         "replays the F# walk"),
+        (["64", "imp3D", "gossip", "--sentinel"],
+         "gossip has none"),
+    ]
+    for argv, needle in cases:
+        code, _, err = run_cli(argv + ["--quiet"], capsys)
+        assert code == 2, (argv, err)
+        assert needle in err, (argv, err)
+
+
+def test_cli_value_faults_conflicts_with_plan_key(tmp_path, capsys):
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps(
+        {"value_faults": [{"round": 5, "rate": 0.05, "model": "nan"}]}))
+    code, _, err = run_cli([
+        "64", "imp3D", "push-sum", "--event-plan", str(plan_file),
+        "--value-faults", "0.05,nan", "--quiet",
+    ], capsys)
+    assert code == 2
+    assert "configure one" in err
+
+
+def test_cli_chaos_run_and_resume_plan_pinning(tmp_path, capsys):
+    """E2E chaos smoke + the trajectory contract: a resume under a
+    DIFFERENT fault plan is refused (the checkpoint pins the
+    value_faults digest); the same plan resumes fine."""
+    ckdir = str(tmp_path / "ck")
+    base = ["64", "imp3D", "push-sum", "--seed", "7", "--sentinel",
+            "quarantine", "--repair", "rewire", "--max-rounds", "300",
+            "--quiet"]
+    code, _, err = run_cli(base + [
+        "--value-faults", "0.05,nan,5", "--checkpoint-dir", ckdir,
+        "--checkpoint-every", "1", "--chunk-rounds", "4"], capsys)
+    assert code == 0, err
+    # different model -> different digest -> refused
+    code, _, err = run_cli(base + [
+        "--value-faults", "0.05,inf,5", "--resume", ckdir], capsys)
+    assert code == 2
+    assert "checkpoint mismatch" in err and "value_faults" in err
+    # the run's own plan resumes (and re-running the past injection on
+    # already-dead rows is a no-op)
+    code, _, err = run_cli(base + [
+        "--value-faults", "0.05,nan,5", "--resume", ckdir], capsys)
+    assert code == 0, err
+
+
+def test_cli_auto_resume_mesh_policy(tmp_path, capsys, monkeypatch):
+    """--auto-resume now allows single-process multi-device meshes (one
+    process owns the mesh, so its recovery exec re-owns it whole); a
+    multi-process runtime keeps the loud refusal."""
+    ckdir = str(tmp_path / "ck")
+    argv = ["64", "imp3D", "gossip", "--devices", "2", "--backend", "cpu",
+            "--seed", "0", "--chunk-rounds", "64", "--auto-resume", "1",
+            "--checkpoint-dir", ckdir, "--checkpoint-every", "1", "--quiet"]
+    code, _, err = run_cli(argv, capsys)
+    assert code == 0, err
+    assert "single-process only" not in err
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    code, _, err = run_cli(argv, capsys)
+    assert code == 2
+    assert "--auto-resume is single-process only" in err
+    assert "relaunching the job from --checkpoint-dir" in err
+
+
+# ----------------------------------------------------- telemetry rollup
+
+
+def test_telemetry_chaos_report_and_healthy_silence(tmp_path, capsys):
+    """The report narrates the whole incident (injection, trip,
+    quarantine) yet a converged containment run raises NO anomaly; a
+    healthy sentinel-on run stays 'anomalies: none' with a zeroed
+    rollup."""
+    chaos = str(tmp_path / "chaos")
+    code, _, err = run_cli([
+        "64", "imp3D", "push-sum", "--seed", "7", "--value-faults",
+        "0.02,nan", "--sentinel", "quarantine", "--repair", "rewire",
+        "--telemetry-dir", chaos, "--max-rounds", "300", "--quiet",
+    ], capsys)
+    assert code == 0, err
+    code, out, _ = run_cli(["report", chaos], capsys)
+    assert code == 0
+    assert "value fault injected:" in out
+    assert "sentinel trip: nonfinite" in out
+    assert "quarantined:" in out and "(repair rewire)" in out
+    assert "anomalies: none" in out
+    with open(os.path.join(chaos, "run.json")) as fh:
+        manifest = json.load(fh)
+    roll = manifest["sentinel"]
+    assert roll["mode"] == "quarantine"
+    assert roll["trips"] == 1 and roll["quarantine_events"] == 1
+    assert roll["quarantined_nodes"] >= 1
+    assert manifest["config"]["event_plan"]["value_fault_events"] == 1
+    assert manifest["config"]["event_plan"]["value_faults"] != "none"
+
+    healthy = str(tmp_path / "healthy")
+    code, _, err = run_cli([
+        "64", "imp3D", "push-sum", "--seed", "7", "--sentinel",
+        "--telemetry-dir", healthy, "--quiet",
+    ], capsys)
+    assert code == 0, err
+    code, out, _ = run_cli(["report", healthy], capsys)
+    assert code == 0
+    assert "anomalies: none" in out
+    assert "sentinel trip" not in out
+    with open(os.path.join(healthy, "run.json")) as fh:
+        roll = json.load(fh)["sentinel"]
+    assert roll == {"mode": "on", "trips": 0, "rollbacks": 0,
+                    "quarantine_events": 0, "quarantined_nodes": 0}
+
+
+def test_detect_only_unrecovered_run_flags_anomaly(tmp_path, capsys):
+    """The flip side of the silence contract: a trip the run never
+    recovered from (detect-only stops unconverged) IS an anomaly."""
+    tdir = str(tmp_path / "t")
+    code, _, err = run_cli([
+        "64", "imp3D", "push-sum", "--seed", "7", "--value-faults",
+        "0.05,nan,5", "--sentinel", "on", "--telemetry-dir", tdir,
+        "--max-rounds", "60", "--quiet",
+    ], capsys)
+    # exit 1: the run legitimately did not converge (detect-only stops)
+    assert code in (0, 1), err
+    code, out, _ = run_cli(["report", tdir], capsys)
+    assert code == 0
+    assert "sentinel TRIPPED" in out
+    assert "anomalies: none" not in out
