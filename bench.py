@@ -131,6 +131,14 @@ def _delivery_microbench() -> None:
     interpreted is ~64 matvecs per timed call). ``BENCH_PAYLOAD_WIRE``
     stamps the wire column (f32/bf16/int8) into the record so one
     campaign certifies kernel, overlap, and wire together.
+
+    A third section (``hub_graphs``) reruns routed vs pallas vs the
+    K ∈ {1, 4} megakernel on skewed graphs — a power-law graph and the
+    same graph re-imported through ``edgefile:`` — exercising the
+    hub-splitting class layout. Each row gates on in-loop bitwise
+    equality against routed and stamps ``max_degree`` plus the layout's
+    split-class/sub-class counts. ``BENCH_HUB_NODES`` (default 4096)
+    sizes the hub graphs; 0 skips the section.
     """
     import jax
     import jax.numpy as jnp
@@ -229,6 +237,97 @@ def _delivery_microbench() -> None:
                 "payload_wire": wire,
             }
 
+    # --- hub graphs: power-law + edgefile through the split layout -------
+    def _bench_hub_graph(topo_h):
+        from gossipprotocol_tpu.ops.delivery import hub_split_counts
+        from gossipprotocol_tpu.ops.megakernel import (
+            build_megakernel_delivery,
+            make_megakernel_round,
+        )
+        from gossipprotocol_tpu.protocols.state import pushsum_init
+
+        xs = jax.random.uniform(jax.random.PRNGKey(1),
+                                (topo_h.num_nodes,), jnp.float32)
+        xw = jnp.ones((topo_h.num_nodes,), jnp.float32)
+        row = {"nodes": topo_h.num_nodes,
+               "max_degree": int(np.asarray(topo_h.degree).max())}
+        outs = {}
+        deliveries = {}
+        for pname, build, to_dev in (
+            ("routed", routed_mod.build_routed_delivery,
+             routed_mod.to_device),
+            ("pallas", pallas_mod.build_pallas_delivery,
+             pallas_mod.to_device),
+        ):
+            d = to_dev(build(topo_h))
+            deliveries[pname] = d
+            fn = jax.jit(
+                lambda a, b, d=d: d.matvec(a, b, interpret=interpret))
+            ys, yw = fn(xs, xw)
+            jax.block_until_ready((ys, yw))
+            outs[pname] = (np.asarray(ys), np.asarray(yw))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                ys, yw = fn(ys, yw)
+            jax.block_until_ready((ys, yw))
+            row[pname + "_matvec_ms"] = round(
+                (time.perf_counter() - t0) / iters * 1e3, 3)
+        # in-loop bitwise gate: a wrong-fast hub kernel must not emit a
+        # datapoint
+        np.testing.assert_array_equal(outs["routed"][0], outs["pallas"][0])
+        np.testing.assert_array_equal(outs["routed"][1], outs["pallas"][1])
+        n_split, n_sub, widest = hub_split_counts(
+            deliveries["pallas"].classes)
+        row.update(split_classes=n_split, subclasses=n_sub,
+                   widest_class=widest, bitwise_equal=True)
+        pd_h = deliveries["pallas"]
+        if (pd_h.gather_pre.mode == "resident"
+                and pd_h.gather_out.mode == "resident"):
+            mk_h = build_megakernel_delivery(pd_h)
+            state0_h = pushsum_init(topo_h.num_nodes)
+            key_h = jax.random.PRNGKey(0)
+            k_it = int(os.environ.get("BENCH_KSWEEP_ITERS",
+                                      3 if interpret else 10))
+            for k in (1, 4):
+                core = make_megakernel_round(
+                    n=topo_h.num_nodes, rounds_per_kernel=k, eps=1e-6,
+                    streak_target=2 ** 30, predicate="delta", tol=1e-4,
+                    interpret=interpret)
+                fn = jax.jit(lambda st, core=core: core(st, mk_h, key_h))
+                st = fn(state0_h)
+                jax.block_until_ready(st)
+                t0 = time.perf_counter()
+                for _ in range(k_it):
+                    st = fn(st)
+                jax.block_until_ready(st)
+                row[f"megakernel_K{k}_per_round_ms"] = round(
+                    (time.perf_counter() - t0) / (k_it * k) * 1e3, 3)
+        return row
+
+    hub_rows = {}
+    hub_n = int(os.environ.get("BENCH_HUB_NODES", 4096))
+    if hub_n:
+        import tempfile
+
+        topo_pl = build_topology("powerlaw", hub_n, seed=0, m=8)
+        hub_rows["power_law"] = _bench_hub_graph(topo_pl)
+        # the same graph through the edge-file importer: proves the
+        # on-disk real-graph path feeds the identical split layout
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".txt", delete=False) as fh:
+            off = np.asarray(topo_pl.offsets)
+            ind = np.asarray(topo_pl.indices)
+            for u in range(topo_pl.num_nodes):
+                for v in ind[off[u]:off[u + 1]]:
+                    if u < v:
+                        fh.write(f"{u} {v}\n")
+            edge_path = fh.name
+        try:
+            topo_ef = build_topology(f"edgefile:{edge_path}", hub_n)
+            hub_rows["edgefile"] = _bench_hub_graph(topo_ef)
+        finally:
+            os.unlink(edge_path)
+
     print(json.dumps({
         "metric": "delivery_matvec_imp3d",
         "nodes": topo.num_nodes,
@@ -242,6 +341,7 @@ def _delivery_microbench() -> None:
             paths["routed"]["matvec_ms"] / paths["pallas"]["matvec_ms"], 2),
         "paths": paths,
         "megakernel_ksweep": ksweep or None,
+        "hub_graphs": hub_rows or None,
         "peak_rss_bytes": _peak_rss(),
     }))
 

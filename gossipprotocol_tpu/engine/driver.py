@@ -1275,6 +1275,26 @@ def run_clock_spec(topo: Topology, cfg: RunConfig) -> tuple:
     return clock_spec(cfg.clock, cfg.activation_rate, id_div=id_div)
 
 
+def note_hub_split(tel, topo) -> None:
+    """Stamp the hub-splitting layout geometry on the telemetry hub —
+    report/manifest surface it as ``hub split: N classes -> M
+    sub-classes (max degree D)``. Computed from the degree census (the
+    split is a pure function of the populated degree classes, same on
+    every delivery path). Left unset — not zeroed — on degree-regular
+    graphs, so pre-split manifests and records stay byte-identical."""
+    from gossipprotocol_tpu.ops.delivery import degree_classes
+
+    deg = np.asarray(topo.degree)
+    cls = np.unique(degree_classes(deg))
+    split = [int(c) for c in cls if 2 * c > 128]
+    if split:
+        tel.hub_split = {
+            "classes": len(split),
+            "subclasses": int(sum((2 * c) // 128 for c in split)),
+            "max_degree": int(deg.max()),
+        }
+
+
 def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
     """The runtime adjacency pytree the chunk runner threads through:
     sampled neighbor tables for the single-target senders (plus the
@@ -1324,6 +1344,8 @@ def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
                     streamed_bytes_per_round=routed_streamed_bytes_per_round(
                         rd),
                 )
+            if tel is not None:
+                note_hub_split(tel, topo)
             return rd
         if cfg.delivery in ("pallas", "megakernel"):
             from gossipprotocol_tpu.ops.pallasdelivery import (
@@ -1339,10 +1361,12 @@ def device_arrays(topo: Topology, cfg: RunConfig, tel=None):
                     streamed_bytes_per_round=pallas_streamed_bytes_per_round(
                         pd),
                 )
+            if tel is not None:
+                note_hub_split(tel, topo)
             if use_megakernel(cfg):
                 # same cached gather plans, wrapped with the precomputed
-                # f32 degree; eligibility (resident gathers, foldable
-                # classes) is checked loudly here, before any compile
+                # f32 degree; eligibility (resident gathers) is checked
+                # loudly here, before any compile
                 from gossipprotocol_tpu.ops.megakernel import (
                     build_megakernel_delivery,
                 )
@@ -2332,7 +2356,8 @@ def run_simulation(
     tel.record_compiled(
         "chunk", compiled, engine="single-chip", delivery=cfg.delivery,
         rounds_per_kernel=(rounds_per_step if use_megakernel(cfg)
-                           else None))
+                           else None),
+        hub_split=(getattr(tel, "hub_split", None) or {}).get("classes"))
 
     def step(s, round_limit):
         return compiled(s, nbrs, base_key, jnp.int32(round_limit))
@@ -2367,7 +2392,8 @@ def run_simulation(
             "chunk_rebuild", compiled2, engine="single-chip",
             delivery=cfg.delivery,
             rounds_per_kernel=(rounds_per_step if use_megakernel(cfg)
-                               else None))
+                               else None),
+            hub_split=(getattr(tel, "hub_split", None) or {}).get("classes"))
 
         def step2(s, round_limit):
             return compiled2(s, nbrs2, base_key, jnp.int32(round_limit))

@@ -149,14 +149,7 @@ def _pallas_gather_bytes(e_local: int, local_rows: int,
         LANES, TILE, _resident_rows,
     )
 
-    # populated-class upper bound from the degree range: one ceil-pow2
-    # class per octave up to max_degree, with the 128/256 band merged
-    # into 512 (delivery.degree_classes)
-    cp2 = 1 << max(0, (max(1, max_degree) - 1)).bit_length()
-    n_classes = cp2.bit_length()
-    if cp2 >= 512:
-        n_classes -= 2
-    pairs = e_local + n_classes * BLK * (LANES // 2)
+    pairs = _class_pair_slots(e_local, max_degree)
 
     resident = _resident_rows()
 
@@ -180,17 +173,47 @@ def _wire_bytes(cfg) -> int:
 
 
 def _class_pair_slots(num_edges: int, max_degree: int) -> int:
-    """Class-layout pair-slot upper bound: edges plus the BLK-row
-    quantization floor every populated small class pays (mirrors
-    ``delivery.degree_classes`` / ``build_gather_plan``)."""
+    """Class-layout pair-slot upper bound: edges plus a per-class
+    quantization floor (mirrors ``delivery.degree_classes`` /
+    ``class_layout``). Small classes (c <= 64) pay the flat layout's
+    BLK-row floor as before; split hub classes (one ceil-pow2 class per
+    octave from 512 up to max_degree) pay the hub-splitting layout's
+    floor instead — each sub-class region is node-capacity padded to at
+    least 8 rows, so a split class costs at least ``8 * c`` pairs even
+    with a single member, and never less than the old BLK-row floor."""
     from gossipprotocol_tpu.ops.classops import BLK
     from gossipprotocol_tpu.ops.pallasdelivery import LANES
 
     cp2 = 1 << max(0, (max(1, max_degree) - 1)).bit_length()
-    n_classes = cp2.bit_length()
-    if cp2 >= 512:
-        n_classes -= 2
-    return num_edges + n_classes * BLK * (LANES // 2)
+    if cp2 > 64:
+        cp2 = max(cp2, 512)  # 128/256 band merges into 512
+    n_small = min(cp2.bit_length(), 7)  # classes 1..64
+    floors = n_small * BLK * (LANES // 2)
+    c = 512
+    while c <= cp2:
+        floors += max(BLK * (LANES // 2), 8 * c)
+        c *= 2
+    return num_edges + floors
+
+
+def _hub_split_summary(max_degree: int) -> Optional[Dict[str, int]]:
+    """Predicted hub-splitting layout geometry from the degree range:
+    one split class per octave from 512 up to the merged ceil-pow2 of
+    ``max_degree`` (an upper bound — only populated octaves split on a
+    real graph), each contributing ``2c / 128`` sub-classes. None when
+    the layout has no split classes (degree-regular regime: the literal
+    pre-split kernels trace)."""
+    cp2 = 1 << max(0, (max(1, max_degree) - 1)).bit_length()
+    if cp2 > 64:
+        cp2 = max(cp2, 512)
+    if cp2 < 512:
+        return None
+    split = [512 << i for i in range((cp2 // 512).bit_length())]
+    return {
+        "classes": len(split),
+        "subclasses": sum((2 * c) // 128 for c in split),
+        "max_degree": int(max_degree),
+    }
 
 
 def megakernel_vmem_estimate(num_nodes: int, num_edges: int,
@@ -397,6 +420,9 @@ def estimate_run_bytes(
         "lanes": lanes,
         "num_edges": int(num_edges),
         "delivery_path": path,
+        "hub_split": (_hub_split_summary(max_degree)
+                      if path in ("routed", "pallas", "megakernel")
+                      else None),
         "dtype_bytes": B,
         "payload_dim": d,
         "per_device": {
@@ -758,6 +784,11 @@ def main(argv=None) -> int:
               f"d={doc['payload_dim']} x {doc['dtype_bytes']} B")
         print(f"  state:        {_fmt(per['state_bytes']):>12}/device")
         print(f"  delivery:     {_fmt(per['delivery_bytes']):>12}/device")
+        hs = doc.get("hub_split")
+        if hs:
+            print(f"  hub split:    {hs['classes']} classes -> "
+                  f"{hs['subclasses']} sub-classes "
+                  f"(max degree ~{hs['max_degree']})")
         if per["data_bytes"]:
             print(f"  workload data:{_fmt(per['data_bytes']):>12}/device")
         print(f"  temp (est):   {_fmt(per['temp_bytes']):>12}/device")

@@ -120,6 +120,10 @@ def build_manifest(
         # obs/predict.py round prediction, updated by the driver with the
         # actual outcome (predicted_rounds / actual_rounds / over_budget)
         "prediction": getattr(tel, "prediction", None),
+        # hub-splitting layout geometry on routed/pallas/megakernel runs
+        # (classes / subclasses / max_degree); None on degree-regular
+        # graphs, where the layout and kernels are the pre-split ones
+        "hub_split": getattr(tel, "hub_split", None),
         # trace.jsonl bookkeeping (rows written, final stride, cap)
         "trace": (tel.trace_summary()
                   if hasattr(tel, "trace_summary") else None),

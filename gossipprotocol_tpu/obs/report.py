@@ -182,11 +182,13 @@ def _render_resources(data: Dict[str, Any], manifest, out: TextIO) -> None:
             # bf16]`: the shard count subsumes the "sharded" engine word
             shards = prog.get("num_shards")
             k = prog.get("rounds_per_kernel")
+            hs = prog.get("hub_split")
             tags = [t for t in (
                 f"{shards}-shard" if shards else prog.get("engine"),
                 prog.get("delivery"),
                 f"K={k}" if k else None,
                 prog.get("payload_wire"),
+                f"split={hs}" if hs else None,
             ) if t]
             if tags:
                 label = f"{label} [{', '.join(tags)}]"
@@ -250,6 +252,15 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
                 + (f", estimate error {err:.3e}" if err is not None else "")
                 + "\n"
             )
+
+    # hub split ----------------------------------------------------------
+    hs = (manifest or {}).get("hub_split")
+    if hs:
+        out.write(
+            f"hub split: {hs.get('classes', '?')} classes -> "
+            f"{hs.get('subclasses', '?')} sub-classes "
+            f"(max degree {hs.get('max_degree', '?')})\n"
+        )
 
     # prediction ---------------------------------------------------------
     pred = (manifest or {}).get("prediction")

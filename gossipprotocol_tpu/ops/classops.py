@@ -22,11 +22,21 @@ Small classes (2c <= 128 f32 lanes): a row holds 128/(2c) node runs.
           gather packs them left.
   expand: a static lane gather replicates each packed pair across its
           run (lane j reads lane 2*(j // (2c)) + j % 2).
-Big classes (2c > 128): a node run spans q = 2c/128 whole rows.
+Big classes (2c > 128): the hub-splitting layout. A node's c pair
+slots split into q = 2c/128 sub-classes of 64 pairs (one whole row)
+each; the region is sub-class-major — row j*cap + r holds node r's
+j-th 64-pair chunk, cap the class's aligned node capacity.
   reduce: full-row stride-2 fold to per-row (s, w) partials, then the
-          q rows accumulate into one output block (grid revisiting).
-  expand: each output row block reads its node's packed pair and
+          q sub-class partials of each node accumulate into one output
+          row in ascending-j order — the fixed canonical sub-class
+          order every delivery path (routed, pallas, megakernel)
+          reproduces, which is what keeps them bitwise-identical on
+          hub graphs.
+  expand: each sub-class row reads its node's packed pair and
           broadcasts it across the lanes.
+(:func:`class_reduce_big` / :func:`class_expand_big` are the pre-split
+node-major row kernels, kept for reference/experiments — no delivery
+path emits their layout anymore.)
 """
 
 from __future__ import annotations
@@ -122,6 +132,94 @@ def class_expand_small(packed: jax.Array, c: int,
         out_shape=jax.ShapeDtypeStruct((rows, LANES), packed.dtype),
         in_specs=[pl.BlockSpec((BLK, in_lanes), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((BLK, LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(view)
+    return out.reshape(-1)
+
+
+def class_reduce_split(region: jax.Array, c: int,
+                       interpret: bool = False) -> jax.Array:
+    """Reduce a hub-split class region: q = 2c/128 sub-classes of one
+    64-pair row per node, sub-class-major (row j*cap + r = node r's
+    j-th chunk).
+
+    ``region``: f32 [q * cap * 128] flat (``cap`` the aligned node
+    capacity — a multiple of 8, and of BLK past BLK rows, so the grid
+    blocks tile it exactly). Returns f32 [2 * cap] packed (s, w) per
+    node slot.
+
+    The second-level reduction accumulates sub-class partials in
+    ascending-j grid order — j is the LAST grid dimension, so the
+    output-block revisits are consecutive grid steps (the Mosaic
+    revisiting rule) and the accumulation order is the fixed canonical
+    sub-class order the megakernel's left-fold replays bitwise.
+    """
+    q = (2 * c) // LANES
+    assert q * LANES == 2 * c
+    view = region.reshape(-1, LANES)
+    cap = view.shape[0] // q
+    assert cap * q == view.shape[0], (view.shape[0], q)
+    cb = cap if cap <= BLK else BLK
+    assert cap % cb == 0 and cb % 8 == 0, (cap, cb)
+    rsteps = cap // cb
+
+    def kernel(x_ref, o_ref):
+        j = pl.program_id(1)
+        acc = x_ref[...]
+        sh = 2
+        while sh < LANES:
+            acc = acc + jnp.roll(acc, -sh, axis=1)
+            sh *= 2
+        partial = acc[:, :2]
+
+        @pl.when(j == 0)
+        def _init():
+            o_ref[...] = partial
+
+        @pl.when(j != 0)
+        def _acc():
+            o_ref[...] = o_ref[...] + partial
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(rsteps, q),
+        out_shape=jax.ShapeDtypeStruct((cap, 2), region.dtype),
+        in_specs=[pl.BlockSpec((cb, LANES),
+                               lambda rb, j: (j * rsteps + rb, 0))],
+        out_specs=pl.BlockSpec((cb, 2), lambda rb, j: (rb, 0)),
+        interpret=interpret,
+    )(view)
+    return out.reshape(-1)
+
+
+def class_expand_split(packed: jax.Array, c: int,
+                       interpret: bool = False) -> jax.Array:
+    """Replicate each node pair across its q = 2c/128 sub-class rows
+    (the inverse of :func:`class_reduce_split`'s layout).
+
+    ``packed``: f32 [2 * cap]; returns f32 [q * cap * 128] with row
+    j*cap + r carrying node r's pair on every lane run.
+    """
+    q = (2 * c) // LANES
+    assert q * LANES == 2 * c
+    cap = packed.shape[0] // 2
+    view = packed.reshape(cap, 2)
+    cb = cap if cap <= BLK else BLK
+    assert cap % cb == 0 and cb % 8 == 0, (cap, cb)
+    rsteps = cap // cb
+
+    def kernel(x_ref, o_ref):
+        x = x_ref[...]
+        col = jax.lax.broadcasted_iota(jnp.int32, o_ref.shape, 1)
+        o_ref[...] = jnp.where(col % 2 == 0, x[:, 0:1], x[:, 1:2])
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(rsteps, q),
+        out_shape=jax.ShapeDtypeStruct((q * cap, LANES), packed.dtype),
+        in_specs=[pl.BlockSpec((cb, 2), lambda rb, j: (rb, 0))],
+        out_specs=pl.BlockSpec((cb, LANES),
+                               lambda rb, j: (j * rsteps + rb, 0)),
         interpret=interpret,
     )(view)
     return out.reshape(-1)

@@ -93,12 +93,23 @@ def class_order(cls: np.ndarray, n: int, shuffle_seed: int = 0xC105):
 
 
 def class_layout(c_sorted: np.ndarray, caps: dict | None = None):
-    """(classes, node_start_pair, m_pairs, cap_node_pos) from the sorted
-    class vector.
+    """(classes, node_start_pair, m_pairs, cap_node_pos, pair_stride)
+    from the sorted class vector.
 
     Pallas-aligned regions (see ops/classops): small classes pad to
-    BLK-row multiples with phantom node slots; big classes cover whole
-    rows by construction.
+    BLK-row multiples with phantom node slots; hub classes (2c > 128)
+    use the two-level hub-splitting layout — the class splits into
+    q = 2c/128 sub-classes of 64 pairs (one whole row) per node,
+    stored sub-class-major: region row j*cap + r is node r's j-th
+    64-pair chunk, with the node capacity ``cap`` aligned (8 node
+    slots, or BLK past BLK) so the split kernels' grid blocks tile it
+    exactly. A node's k-th pair slot is therefore NOT node-contiguous
+    anymore; address it through :func:`edge_pair_slot`.
+
+    ``pair_stride``: int64 [nu] — the pair distance between a node's
+    consecutive sub-class chunks (``cap * 64`` for split-class nodes;
+    64 for small-class nodes, where it is never exercised because
+    k < c <= 64 keeps every slot in chunk 0).
 
     ``caps``: optional forced per-class node-capacity minima
     (``{class: n_c_min}``) — the geometry-uniformization hook for
@@ -127,6 +138,7 @@ def class_layout(c_sorted: np.ndarray, caps: dict | None = None):
     classes = []
     node_start_pair = np.zeros(nu, np.int64)
     cap_node_pos = np.zeros(nu, np.int64)
+    pair_stride = np.full(nu, 64, np.int64)
     cursor = 0
     cap_nodes = 0
     for c in all_cls:
@@ -139,16 +151,59 @@ def class_layout(c_sorted: np.ndarray, caps: dict | None = None):
             rows = -(-(n_eff * 2 * c) // 128)
             rows = -(-rows // BLK) * BLK
             cap = rows * 128 // (2 * c)
+            node_start_pair[i:j] = (cursor
+                                    + np.arange(n_c, dtype=np.int64) * c)
         else:
+            # hub split: q sub-classes of 64 pairs, sub-class-major.
+            # The alignment keeps cap a divisor-friendly multiple for
+            # the split kernels' row blocks (cb = min(cap, BLK) must
+            # tile cap) AND idempotent under the forced-caps
+            # uniformization (an aligned cap re-aligns to itself).
             q = (2 * c) // 128
-            rows = n_eff * q
-            cap = n_eff
-        node_start_pair[i:j] = cursor + np.arange(n_c, dtype=np.int64) * c
+            align = 8 if n_eff <= BLK else BLK
+            cap = -(-n_eff // align) * align
+            rows = q * cap
+            node_start_pair[i:j] = (cursor
+                                    + np.arange(n_c, dtype=np.int64) * 64)
+            pair_stride[i:j] = cap * 64
         cap_node_pos[i:j] = cap_nodes + np.arange(n_c, dtype=np.int64)
         classes.append((c, n_c, int(cursor), int(rows), int(cap)))
         cursor += cap * c
         cap_nodes += cap
-    return tuple(classes), node_start_pair, int(cursor), cap_node_pos
+    return (tuple(classes), node_start_pair, int(cursor), cap_node_pos,
+            pair_stride)
+
+
+def edge_pair_slot(node_start_pair: np.ndarray, pair_stride: np.ndarray,
+                   ranks: np.ndarray, k: np.ndarray) -> np.ndarray:
+    """Pair slot of the ``k``-th edge of the dense-rank-``ranks`` node
+    under the (possibly hub-split) class layout: chunk k // 64 at
+    in-chunk position k % 64. Small-class nodes (k < c <= 64) stay in
+    chunk 0, so the formula degenerates to the pre-split
+    ``node_start_pair + k`` byte-for-byte — layouts without hub
+    classes produce identical tables."""
+    return (node_start_pair[ranks] + (k >> 6) * pair_stride[ranks]
+            + (k & 63))
+
+
+def hub_split_counts(classes) -> Tuple[int, int, int]:
+    """(split classes, total sub-classes, widest class) of a layout's
+    classes tuple — the counts the report/manifest surface as
+    ``hub split: N classes -> M sub-classes``. Zero split classes means
+    the layout (and every kernel it traces) is byte-identical to the
+    pre-split flat layout."""
+    split = [c for c, n_c, *_ in classes if 2 * c > 128]
+    return (len(split), sum((2 * c) // 128 for c in split),
+            max(split, default=0))
+
+
+def split_pad_pairs_of(classes) -> int:
+    """Pair slots a layout spends on split-class node-capacity padding
+    (``(cap - n_eff) * c`` per hub class). The hub layout pads each
+    sub-class region to the same ``cap`` rows, so every phantom node
+    costs ``c`` pairs rather than the flat layout's row remainder."""
+    return sum((cap - n_c) * c
+               for c, n_c, _, _, cap in classes if 2 * c > 128)
 
 
 # --- pytree registration: geometry static, tables dynamic ----------------
@@ -249,7 +304,7 @@ class RoutedDelivery(NamedTuple):  # registered below: geometry static
             if 2 * c <= 128:
                 segs.append(co.class_expand_small(node_pairs, c, interpret))
             else:
-                segs.append(co.class_expand_big(node_pairs, c, interpret))
+                segs.append(co.class_expand_split(node_pairs, c, interpret))
             off += n_c
         e1 = jnp.concatenate(segs) * self.realmask
         f = _apply_chain(self.plan_m, e1, interpret,
@@ -261,7 +316,7 @@ class RoutedDelivery(NamedTuple):  # registered below: geometry static
             if 2 * c <= 128:
                 packed = co.class_reduce_small(region, c, interpret)
             else:
-                packed = co.class_reduce_big(region, c, interpret)
+                packed = co.class_reduce_split(region, c, interpret)
             ys.append(packed[: 2 * n_c])
         yf = jnp.concatenate(ys)
         nat = _apply_chain(self.plan_out, yf, interpret,
@@ -395,10 +450,12 @@ def build_routed_delivery(topo: Topology, progress=None,
 
     # class segment table with Pallas-aligned regions (see ops/classops):
     # small classes (2c <= 128 lanes) pad their region to BLK-row
-    # multiples with phantom node slots; big classes cover whole rows by
-    # construction. Phantom/class-pad slots are -1 (never routed) and
-    # read as exact zeros out of the final pass.
-    classes, node_start_pair, m_pairs, _ = class_layout(cls[order])
+    # multiples with phantom node slots; hub classes (2c > 128) take the
+    # sub-class-major hub-splitting layout, with aligned node capacity.
+    # Phantom/class-pad slots are -1 (never routed) and read as exact
+    # zeros out of the final pass.
+    classes, node_start_pair, m_pairs, _, pair_stride = class_layout(
+        cls[order])
 
     if progress:
         progress(f"routed delivery: n={n} nu={nu} m_pairs={m_pairs} "
@@ -426,7 +483,8 @@ def build_routed_delivery(topo: Topology, progress=None,
     # directed edge e (row u, slot k): E1 slot = node_start_pair[rank[u]] + k
     # its value lands at (v, rank of reverse edge v->u in v's row)
     src_nodes = np.repeat(np.arange(n, dtype=np.int64), degree)
-    e1_slot = node_start_pair[rank[src_nodes]] + (
+    e1_slot = edge_pair_slot(
+        node_start_pair, pair_stride, rank[src_nodes],
         np.arange(len(indices), dtype=np.int64) - offsets[src_nodes])
     # reverse-edge rank: position of (v, u) in v's row, via sort pairing.
     # The canonical CSR is (u, v)-lexicographic already (csr_from_edges
@@ -449,7 +507,8 @@ def build_routed_delivery(topo: Topology, progress=None,
     reverse_of[fwd] = rev
     in_rank = np.empty(len(indices), np.int64)
     in_rank[reverse_of] = np.arange(len(indices)) - offsets[src_nodes]
-    f_slot = node_start_pair[rank[indices]] + in_rank
+    f_slot = edge_pair_slot(node_start_pair, pair_stride,
+                            rank[indices], in_rank)
     src_of_m = np.full(m_pairs, -1, np.int64)
     src_of_m[f_slot] = e1_slot
     # every non-real slot (class pad, phantom, alignment) stays -1: the

@@ -31,11 +31,14 @@ that), but never runs past convergence.
 
 Eligibility is deliberately narrow — the fast path for the regime that
 needs it, loud errors everywhere else: both gathers resident (raise the
-``GOSSIP_TPU_PALLAS_RESIDENT_ROWS`` budget to widen), no degree class
-wider than one 128-lane row (hub classes with 2c > 128 need the
-accumulating big-class kernel, which has no in-register equivalent),
-plus the driver-level gates (sync clock, scalar payload, all-alive,
-single chip — RunConfig enforces).
+``GOSSIP_TPU_PALLAS_RESIDENT_ROWS`` budget to widen), plus the
+driver-level gates (sync clock, scalar payload, all-alive, single chip —
+RunConfig enforces). Hub classes (2c > 128) arrive in the hub-splitting
+sub-class-major layout (``delivery.class_layout``): the in-kernel fold
+runs the per-row lane roll across all sub-class rows at once, then sums
+the q sub-class partials in ascending sub-class order — the same
+canonical left-fold ``class_reduce_split`` accumulates in, keeping
+K-round megakernels bitwise-equal to routed/pallas on skewed graphs too.
 """
 
 from __future__ import annotations
@@ -92,8 +95,8 @@ _register_megakernel()
 
 def check_megakernel_eligible(pd: PallasDelivery) -> None:
     """Raise :class:`RoutedConfigError` unless the whole round fits the
-    in-kernel loop: both gathers VMEM-resident and every degree class
-    foldable within one 128-lane row."""
+    in-kernel loop: both gathers VMEM-resident. Hub classes are fine —
+    the split layout's sub-class partial sums fold in-register."""
     bucketed = [name for name, g in (("gather_pre", pd.gather_pre),
                                      ("gather_out", pd.gather_out))
                 if g.mode != "resident"]
@@ -103,14 +106,6 @@ def check_megakernel_eligible(pd: PallasDelivery) -> None:
             "compiled in bucket mode at this size. Raise the resident "
             "budget (GOSSIP_TPU_PALLAS_RESIDENT_ROWS, default 8192 "
             "128-lane rows) if VMEM allows, or use --delivery pallas"
-        )
-    wide = sorted({c for c, *_ in pd.classes if 2 * c > LANES})
-    if wide:
-        raise RoutedConfigError(
-            f"megakernel folds each degree class within one {LANES}-lane "
-            f"row; hub classes {wide} span multiple rows (2c > {LANES}) "
-            "and need the accumulating big-class kernel — use "
-            "--delivery pallas"
         )
 
 
@@ -179,21 +174,39 @@ def make_megakernel_round(*, n: int, rounds_per_kernel: int,
                 xp = jnp.pad(flat, (0, pre.src_rows * LANES - 2 * n))
                 f = jnp.take(xp, idx_pre, axis=None)[: pre.out_len]
                 ys = []
-                for c, n_c, start, reg_rows, _cap in classes:
+                for c, n_c, start, reg_rows, cap in classes:
                     region = jax.lax.dynamic_slice_in_dim(
                         f, 2 * start, reg_rows * LANES)
                     two_c = 2 * c
                     acc = region.reshape(-1, LANES)
-                    sh = 2
-                    while sh < two_c:
-                        acc = acc + jnp.roll(acc, -sh, axis=1)
-                        sh *= 2
-                    col = jax.lax.broadcasted_iota(
-                        jnp.int32, acc.shape, 1)
-                    fidx = ((col // 2) * two_c + (col % 2)) % LANES
-                    packed = jnp.take_along_axis(acc, fidx, axis=1)
-                    ys.append(
-                        packed[:, : LANES // c].reshape(-1)[: 2 * n_c])
+                    if two_c <= LANES:
+                        sh = 2
+                        while sh < two_c:
+                            acc = acc + jnp.roll(acc, -sh, axis=1)
+                            sh *= 2
+                        col = jax.lax.broadcasted_iota(
+                            jnp.int32, acc.shape, 1)
+                        fidx = ((col // 2) * two_c + (col % 2)) % LANES
+                        packed = jnp.take_along_axis(acc, fidx, axis=1)
+                        ys.append(
+                            packed[:, : LANES // c]
+                            .reshape(-1)[: 2 * n_c])
+                    else:
+                        # split class: lane-roll every sub-class row
+                        # (row-independent, so one fold covers all q
+                        # sub-class slabs), then sum the q partials in
+                        # ascending sub-class order — the same left
+                        # fold class_reduce_split's grid accumulates in
+                        q = two_c // LANES
+                        sh = 2
+                        while sh < LANES:
+                            acc = acc + jnp.roll(acc, -sh, axis=1)
+                            sh *= 2
+                        part = acc[:, :2]
+                        red = part[0:cap]
+                        for jj in range(1, q):
+                            red = red + part[jj * cap:(jj + 1) * cap]
+                        ys.append(red.reshape(-1)[: 2 * n_c])
                 yf = (jnp.concatenate(ys) if ys
                       else jnp.zeros(0, jnp.float32))
                 yp = jnp.pad(yf, (0, out.src_rows * LANES - yf.shape[0]))

@@ -77,6 +77,7 @@ from jax.experimental.pallas import tpu as pltpu
 from gossipprotocol_tpu.ops import plan as plan_mod
 from gossipprotocol_tpu.ops.delivery import (
     RoutedConfigError, class_layout, class_order, degree_classes,
+    edge_pair_slot,
 )
 from gossipprotocol_tpu.topology.base import Topology
 
@@ -288,7 +289,7 @@ class PallasDelivery(NamedTuple):  # registered below: geometry static
             if 2 * c <= 128:
                 packed = co.class_reduce_small(region, c, interpret)
             else:
-                packed = co.class_reduce_big(region, c, interpret)
+                packed = co.class_reduce_split(region, c, interpret)
             ys.append(packed[: 2 * n_c])
         yf = jnp.concatenate(ys) if ys else jnp.zeros(0, jnp.float32)
         nat = self.gather_out.gather(yf, interpret)
@@ -375,7 +376,8 @@ def build_pallas_delivery(topo: Topology, progress=None,
     degree = np.diff(offsets)
     cls = degree_classes(degree)
     order, rank, nu = class_order(cls, n)
-    classes, node_start_pair, m_pairs, _ = class_layout(cls[order])
+    classes, node_start_pair, m_pairs, _, pair_stride = class_layout(
+        cls[order])
     if progress:
         progress(f"pallas delivery: n={n} nu={nu} m_pairs={m_pairs} "
                  f"classes={[(c, k) for c, k, *_ in classes]}")
@@ -395,7 +397,8 @@ def build_pallas_delivery(topo: Topology, progress=None,
     reverse_of[np.arange(len(indices), dtype=np.int64)] = rev
     in_rank = np.empty(len(indices), np.int64)
     in_rank[reverse_of] = np.arange(len(indices)) - offsets[src_nodes]
-    f_slot = node_start_pair[rank[indices]] + in_rank
+    f_slot = edge_pair_slot(node_start_pair, pair_stride,
+                            rank[indices], in_rank)
 
     # the composed pre-reduce map: reduce pair slot f_slot[e] holds the
     # share of edge source u — lane 0 reads xs[u] (flat slot u), lane 1

@@ -35,7 +35,7 @@ from gossipprotocol_tpu.ops.exec import DeviceFinal, DevicePlan, DeviceStage
 # Bump whenever the on-device table layout changes (shrink/transpose/
 # bitpack conventions in ops/exec.py or the RoutedDelivery fields): a
 # stale-format entry must rebuild, not deserialize garbage.
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
 
 # Provenance stamp only — bumped when the builder implementation changes
 # (parallel builds + incremental fixpoint = 2). NOT a cache-invalidation

@@ -44,6 +44,8 @@ from gossipprotocol_tpu.ops.delivery import (
     class_layout,
     class_order,
     degree_classes,
+    edge_pair_slot,
+    split_pad_pairs_of,
 )
 from gossipprotocol_tpu.ops.exec import device_plan
 from gossipprotocol_tpu.topology.base import Topology
@@ -91,7 +93,7 @@ class ShardRoutedDelivery(NamedTuple):  # registered below (geometry aux)
             if 2 * c <= 128:
                 segs.append(co.class_expand_small(node_pairs, c, interpret))
             else:
-                segs.append(co.class_expand_big(node_pairs, c, interpret))
+                segs.append(co.class_expand_split(node_pairs, c, interpret))
             off += cap
         e1 = jnp.concatenate(segs) * self.realmask
         f = _apply_chain(self.plan_m, e1, interpret,
@@ -103,7 +105,7 @@ class ShardRoutedDelivery(NamedTuple):  # registered below (geometry aux)
             if 2 * c <= 128:
                 packed = co.class_reduce_small(region, c, interpret)
             else:
-                packed = co.class_reduce_big(region, c, interpret)
+                packed = co.class_reduce_split(region, c, interpret)
             ys.append(packed[: 2 * cap])
         yf = jnp.concatenate(ys)
         nat = _apply_chain(self.plan_out, yf, interpret,
@@ -209,7 +211,7 @@ def build_shard_delivery(
         out_deg = np.bincount(src, minlength=n)
         cls_src = degree_classes(out_deg)
         order_s, rank_s, nu_real = class_order(cls_src, n)
-        classes_src, start_src, m_pairs_src, pos_s = class_layout(
+        classes_src, start_src, m_pairs_src, pos_s, stride_s = class_layout(
             cls_src[order_s], caps=caps_src)
         nu_src = sum(cap for *_, cap in classes_src)
 
@@ -228,14 +230,15 @@ def build_shard_delivery(
         out_rank = np.empty(len(src), np.int64)
         out_rank[by_src] = (np.arange(len(src_o))
                             - np.repeat(grp, grp_len))
-        e1_slot = start_src[rank_s[src]] + out_rank
+        e1_slot = edge_pair_slot(start_src, stride_s, rank_s[src],
+                                 out_rank)
 
     if need_tgt:
         # ---- reduce side: targets classed by their full degree -------
         cls_tgt_full = np.zeros(n, np.int64)
         cls_tgt_full[lo:hi_real] = degree_classes(deg_slice)
         order_t, rank_t, _ = class_order(cls_tgt_full, n)
-        classes_tgt, start_tgt, m_pairs_tgt, pos_t = class_layout(
+        classes_tgt, start_tgt, m_pairs_tgt, pos_t, stride_t = class_layout(
             cls_tgt_full[order_t], caps=caps_tgt)
         nu_tgt = sum(cap for *_, cap in classes_tgt)
 
@@ -261,7 +264,7 @@ def build_shard_delivery(
                                    geometry_only=geometry_only)
 
     if "m" in groups:
-        f_slot = start_tgt[rank_t[tgt]] + in_rank
+        f_slot = edge_pair_slot(start_tgt, stride_t, rank_t[tgt], in_rank)
         src_of_m = np.full(m_pairs_tgt, -1, np.int64)
         src_of_m[f_slot] = e1_slot
         realmask_pairs = np.zeros(m_pairs_src, bool)
@@ -682,7 +685,7 @@ class ShardPushDelivery(NamedTuple):  # registered below (geometry aux)
             if 2 * c <= 128:
                 segs.append(co.class_expand_small(node_pairs, c, interpret))
             else:
-                segs.append(co.class_expand_big(node_pairs, c, interpret))
+                segs.append(co.class_expand_split(node_pairs, c, interpret))
             off += cap
         e1 = jnp.concatenate(segs) * self.realmask
         # [f_local | slab]: local edges land straight at their f slots,
@@ -731,7 +734,7 @@ class ShardPushDelivery(NamedTuple):  # registered below (geometry aux)
             if 2 * c <= 128:
                 packed = co.class_reduce_small(region, c, interpret)
             else:
-                packed = co.class_reduce_big(region, c, interpret)
+                packed = co.class_reduce_split(region, c, interpret)
             ys.append(packed[: 2 * cap])
         yf = jnp.concatenate(ys)
         nat = _apply_chain(self.plan_out, yf, interpret,
@@ -799,7 +802,7 @@ def build_shard_push_delivery(
     # one class set for both sides (see the design note above)
     cls = degree_classes(degree)
     order, rank, _ = class_order(cls, local)
-    classes, node_start_pair, m_pairs, pos = class_layout(
+    classes, node_start_pair, m_pairs, pos, stride = class_layout(
         cls[order], caps=caps)
     nu = sum(cap for *_, cap in classes)
 
@@ -812,7 +815,8 @@ def build_shard_push_delivery(
                         deg_slice)
         pos_in_row = (np.arange(len(nbr), dtype=np.int64)
                       - np.repeat(_row_starts(deg_slice), deg_slice))
-        slot = node_start_pair[rank[row - lo]] + pos_in_row
+        slot = edge_pair_slot(node_start_pair, stride, rank[row - lo],
+                              pos_in_row)
         nbr_shard = nbr // local
         is_local = nbr_shard == shard
 
@@ -940,7 +944,8 @@ def build_shard_push_delivery(
 
 def assert_push_tables_linear(m_pairs: int, num_shards: int,
                               block_pairs: int, e_max: int, local: int,
-                              n_classes: int) -> int:
+                              n_classes: int,
+                              split_pad_pairs: int = 0) -> int:
     """The build-time O(E/S + local_n) guard the push design promises.
 
     ``e_max`` is the max per-shard owned directed edge count (== E/S on
@@ -950,12 +955,19 @@ def assert_push_tables_linear(m_pairs: int, num_shards: int,
     is pathologically skewed (e.g. one shard's edges all aimed at one
     other shard inflating the uniform slab capacity) and the push
     design would silently cost O(E) per shard — reject loudly instead.
-    Returns the budget (pairs) for tests to inspect.
+    ``split_pad_pairs``: the hub-splitting layout's node-capacity
+    alignment padding (sum of ``(cap - n_eff) * c`` over split classes,
+    :func:`split_pad_pairs_of`) — deterministic layout geometry, not
+    partition skew, so it rides as an explicit allowance (a star graph's
+    lone degree-4095 node pays 7 phantom capacity slots x 4096 pairs,
+    past the per-class BLK-row term). Returns the budget (pairs) for
+    tests to inspect.
     """
     from gossipprotocol_tpu.ops.classops import BLK
     from gossipprotocol_tpu.ops.delivery import RoutedConfigError
 
-    budget = 16 * (e_max + local) + (n_classes + 1) * BLK * 64 + 64
+    budget = (16 * (e_max + local) + (n_classes + 1) * BLK * 64
+              + int(split_pad_pairs) + 64)
     for name, pairs in (("class-layout", m_pairs),
                         ("all_to_all slab", num_shards * block_pairs)):
         if pairs > budget:
@@ -1023,9 +1035,10 @@ def _build_push_shards(topo: Topology, n_padded: int, num_shards: int,
         np.array(sorted(caps), np.int64),
         np.array([caps[c] for c in sorted(caps)], np.int64),
     ) if caps else np.zeros(0, np.int64)
-    _, _, m_pairs_u, _ = class_layout(cls_sorted, caps=caps)
+    classes_u, _, m_pairs_u, _, _ = class_layout(cls_sorted, caps=caps)
     assert_push_tables_linear(m_pairs_u, num_shards, block_pairs,
-                              e_max, local, len(caps))
+                              e_max, local, len(caps),
+                              split_pad_pairs=split_pad_pairs_of(classes_u))
 
     # cr-floors fixpoint (incremental) + parallel heavy builds, same
     # machinery as build_shard_deliveries

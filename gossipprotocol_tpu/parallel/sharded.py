@@ -990,11 +990,16 @@ def run_simulation_sharded(
     t0 = time.perf_counter()
     with tel.span("jit_compile", engine="sharded"):
         compiled = runner.lower(state, nbrs, seed, jnp.int32(0)).compile()
+    if routed and not run_topo.implicit_full:
+        from gossipprotocol_tpu.engine.driver import note_hub_split
+
+        note_hub_split(tel, run_topo)
     tel.record_compiled(
         "chunk", compiled, engine="sharded", num_shards=num_shards,
         delivery=cfg.delivery,
         payload_wire=(cfg.payload_wire if cfg.payload_wire != "f32"
-                      else None))
+                      else None),
+        hub_split=(getattr(tel, "hub_split", None) or {}).get("classes"))
 
     def step(s, round_limit):
         return compiled(s, nbrs, seed, jnp.int32(round_limit))

@@ -6,9 +6,10 @@ K — not just K=1: the in-kernel loop checks the supervisor predicate
 before each round exactly where the K=1 while-loop cond does, and once
 it fires the remaining iterations freeze the carry, so the final state
 AND the round count match the un-fused trajectory. Eligibility is
-loudly narrow (resident gathers, no hub classes, all-alive sync
-single-chip) — a config it cannot run bitwise must be an error, never
-a silent approximation."""
+loudly narrow (resident gathers, all-alive sync single-chip) — a
+config it cannot run bitwise must be an error, never a silent
+approximation. Hub classes (2c > 128) are served via the hub-splitting
+sub-class layout, so power-law graphs run rather than reject."""
 
 from __future__ import annotations
 
@@ -115,13 +116,25 @@ def test_megakernel_counters_match_pallas(tmp_path):
 # ----------------------------------------------------- loud rejections
 
 
-def test_megakernel_rejects_hub_classes():
-    """power_law grows a 512-wide degree class — the in-register fold
-    cannot span rows, so the build must refuse, not approximate."""
+def test_megakernel_accepts_hub_classes():
+    """power_law grows a 512-wide degree class — the hub-splitting
+    layout folds its sub-class partials in-register, so the build
+    accepts it and the K-round trajectory stays bitwise-equal to the
+    un-fused pallas path (tests/test_hubsplit.py covers the matrix)."""
     topo = build_topology("powerlaw", 400, seed=3, m=3)
     pd = build_pallas_delivery(topo, device=False)
-    with pytest.raises(RoutedConfigError, match="hub classes"):
-        build_megakernel_delivery(pd)
+    mk = build_megakernel_delivery(pd)
+    from gossipprotocol_tpu.ops.delivery import hub_split_counts
+
+    n_split, n_sub, widest = hub_split_counts(mk.pd.classes)
+    assert n_split >= 1 and widest >= 512
+    assert n_sub == sum((2 * c) // 128
+                        for c, *_ in mk.pd.classes if 2 * c > 128)
+    r_pl = run_simulation(topo, RunConfig(**dict(_BASE, delivery="pallas")))
+    r_mk = run_simulation(topo, RunConfig(
+        **dict(_BASE, delivery="megakernel", rounds_per_kernel=4)))
+    assert r_pl.rounds == r_mk.rounds
+    _assert_bitwise(r_pl, r_mk)
 
 
 def test_megakernel_rejects_bucket_mode_gathers():
