@@ -741,6 +741,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "through the remote-TPU tunnel the reported "
                         "compile window is program-load/upload-bound, so "
                         "savings there are marginal")
+    # set by the serve daemon only: identify the run for the telemetry
+    # collision guard + manifest, and attach the admission verdict doc
+    p.add_argument("--request-id", type=str, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--admission-json", type=str, default=None,
+                   help=argparse.SUPPRESS)
     p.add_argument("--check", action="store_true",
                    help="build and validate the topology, print its shape "
                         "summary, and exit without simulating")
@@ -770,6 +776,18 @@ def main(argv=None) -> int:
         from gossipprotocol_tpu.obs.capacity import main as plan_main
 
         return plan_main(effective_argv[1:])
+    if effective_argv and effective_argv[0] == "serve":
+        from gossipprotocol_tpu.serve.supervisor import main as serve_main
+
+        return serve_main(effective_argv[1:])
+    if effective_argv and effective_argv[0] == "submit":
+        from gossipprotocol_tpu.serve.client import submit_main
+
+        return submit_main(effective_argv[1:])
+    if effective_argv and effective_argv[0] == "status":
+        from gossipprotocol_tpu.serve.client import status_main
+
+        return status_main(effective_argv[1:])
 
     args = build_parser().parse_args(argv)
 
@@ -824,9 +842,25 @@ def main(argv=None) -> int:
     # sweep runs keep counters + manifests but not per-round traces
     # (the trace buffer has no lane story yet — the engine would reject)
     _sweeping = args.sweep is not None or args.sweep_seeds is not None
-    tel = (Telemetry(args.telemetry_dir, trace_cap=args.trace_cap,
-                     traces=False if _sweeping else None)
-           if args.telemetry_dir else _null_telemetry)
+    try:
+        tel = (Telemetry(args.telemetry_dir, trace_cap=args.trace_cap,
+                         traces=False if _sweeping else None,
+                         run_id=args.request_id)
+               if args.telemetry_dir else _null_telemetry)
+    except ValueError as e:  # TelemetryDirCollision
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.admission_json and tel.enabled:
+        # the daemon's admission verdict rides into the manifest so a
+        # telemetry dir stays self-describing about why the run ran
+        import json as _json
+
+        try:
+            with open(args.admission_json) as fh:
+                tel.admission = _json.load(fh)
+        except (OSError, _json.JSONDecodeError) as e:
+            print(f"warning: --admission-json unreadable ({e})",
+                  file=sys.stderr)
 
     algo = _ALGO_ALIASES.get(args.algorithm.lower())
     if algo is None:
@@ -1336,6 +1370,14 @@ def main(argv=None) -> int:
         if manifest_path:
             print(f"telemetry: {tel.dir} (render: python -m "
                   f"gossipprotocol_tpu report {tel.dir})")
+    if getattr(result, "stopped", None) == "drain":
+        # graceful stop (serve drain): neither converged nor failed —
+        # exit 3 so a supervisor can tell "paused, checkpoint saved"
+        # from "ran its course without converging" (exit 1)
+        print(f"drained at round {result.rounds} (checkpoint "
+              f"{'saved' if result.checkpoints else 'not configured'})",
+              file=sys.stderr)
+        return 3
     return 0 if result.converged else 1
 
 

@@ -774,6 +774,10 @@ class RunResult:
     final_state: Any
     metrics: List[dict]
     checkpoints: List[str] = dataclasses.field(default_factory=list)
+    # "drain" when an installed stop check (install_stop_check) ended the
+    # run early at a chunk boundary — checkpoint saved, not a convergence
+    # verdict. None for every normally-finished run.
+    stopped: Optional[str] = None
 
     @property
     def estimate_error(self) -> Optional[float]:
@@ -1804,6 +1808,21 @@ def compute_prediction(run_topo, cfg: RunConfig, tel) -> Optional[dict]:
     return pred
 
 
+# Graceful-stop hook (serve/worker SIGTERM drain): a callable checked at
+# every chunk boundary of the host loop. Truthy -> save a checkpoint (when
+# the run checkpoints at all) and return early with RunResult.stopped =
+# "drain" instead of grinding on. Module-level rather than a RunConfig
+# field so a signal handler installed before cli.main() can reach a run
+# whose config it never sees.
+_stop_check: Optional[Callable[[], bool]] = None
+
+
+def install_stop_check(fn: Optional[Callable[[], bool]]) -> None:
+    """Install (or clear, with None) the global graceful-stop check."""
+    global _stop_check
+    _stop_check = fn
+
+
 def _mass_snapshot(state):
     """(Σs, Σw) over every row as float64 host sums — the invariant a
     repair rebuild must preserve bitwise. None for mass-free states
@@ -1887,6 +1906,7 @@ def _drive(
     elif cfg.round_budget is not None:
         budget = int(cfg.round_budget)
     over_budget = False
+    drained = False
     checkpointing = bool(cfg.checkpoint_every and cfg.checkpoint_dir)
     # once per run, not per checkpoint (crc over the CSR)
     adjacency = ckpt_mod.topology_fingerprint(topo) if checkpointing else None
@@ -2215,7 +2235,29 @@ def _drive(
                                         if k != "event"})
             if cfg.metrics_callback:
                 cfg.metrics_callback(ob)
-        if done or stalled or over_budget:
+        if not done and _stop_check is not None and _stop_check():
+            # graceful drain (serve/worker SIGTERM): save a checkpoint
+            # off-cadence so the resume loses nothing, leave a structured
+            # record, and hand back a result stamped "drain" — the run is
+            # paused, not finished
+            drained = True
+            if checkpointing:
+                with tel.span("checkpoint_save", round=cur_round,
+                              reason="drain"):
+                    checkpoints.append(
+                        ckpt_mod.save(
+                            cfg.checkpoint_dir, trim(state), cfg, topo.kind,
+                            adjacency=adjacency, extra_meta=quar_meta(),
+                        )
+                    )
+            rec = {"event": "drained", "round": cur_round,
+                   "checkpointed": checkpointing}
+            metrics.append(rec)
+            tel.metric(rec)
+            tel.event("drained", round=cur_round, checkpointed=checkpointing)
+            if cfg.metrics_callback:
+                cfg.metrics_callback(rec)
+        if done or stalled or over_budget or drained:
             break
     with tel.span("device_sync"):
         jax.block_until_ready(state)
@@ -2250,6 +2292,7 @@ def _drive(
         ),
         metrics=metrics,
         checkpoints=checkpoints,
+        stopped="drain" if drained else None,
     )
 
 

@@ -175,11 +175,51 @@ def _resource_metrics(tel_dir: str) -> Dict[str, Any]:
     return out
 
 
+def _journal_records(root: str) -> List[Dict[str, Any]]:
+    """Daemon request records from serve/ queue-dir journals: one
+    ``request`` row per request (id, admission verdict, queue wait,
+    terminal phase, outcome) so daemon traffic diffs next to standalone
+    runs. Journals are found at ROOT itself (ROOT *is* a queue dir),
+    one level down, and under ``artifacts/``."""
+    from gossipprotocol_tpu.serve import journal as journal_mod
+
+    pats = (os.path.join(root, "journal.jsonl"),
+            os.path.join(root, "*", "journal.jsonl"),
+            os.path.join(root, "artifacts", "*", "journal.jsonl"))
+    seen = set()
+    out: List[Dict[str, Any]] = []
+    for pat in pats:
+        for path in sorted(glob.glob(pat)):
+            real = os.path.realpath(path)
+            if real in seen:
+                continue
+            seen.add(real)
+            states = journal_mod.replay(journal_mod.read_journal(path))
+            for st in states.values():
+                last = st.last
+                out.append({
+                    "v": SCHEMA_VERSION,
+                    "kind": "request",
+                    "source": os.path.relpath(path, root),
+                    "request_id": st.id,
+                    "verdict": st.verdict,
+                    "phase": st.phase,
+                    "queue_wait_s": st.queue_wait_s,
+                    "reason": last.get("reason"),
+                    "converged": last.get("converged"),
+                    "rounds": last.get("rounds"),
+                    "batch": (st.first("batched") or {}).get("batch"),
+                })
+    return out
+
+
 def build_index(root: str, write: bool = True) -> List[Dict[str, Any]]:
-    """Sweep ROOT for bench records and manifests; optionally (re)write
-    ``artifacts/run_index.jsonl`` (atomic tmp+rename — the index is a
-    derived artifact, rebuilt whole each time)."""
-    records = _bench_records(root) + _manifest_records(root)
+    """Sweep ROOT for bench records, manifests, and daemon journals;
+    optionally (re)write ``artifacts/run_index.jsonl`` (atomic
+    tmp+rename — the index is a derived artifact, rebuilt whole each
+    time)."""
+    records = (_bench_records(root) + _manifest_records(root)
+               + _journal_records(root))
     if write and records:
         path = os.path.join(root, INDEX_RELPATH)
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -257,6 +297,22 @@ def render_history(records: List[Dict[str, Any]], out: TextIO,
                          f" / p95 {r['rounds_p95']:.0f}")
             if r.get("over_budget"):
                 line += ", OVER BUDGET"
+            line += f"  ({r['source']})"
+            out.write(line + "\n")
+    requests = [r for r in records if r["kind"] == "request"]
+    if requests:
+        out.write(f"\nindexed daemon requests ({len(requests)}):\n")
+        for r in requests:
+            line = f"  {r.get('request_id')}  {r.get('phase')}"
+            if r.get("verdict") == "refused":
+                line += f"  ({r.get('reason')})"
+            elif r.get("phase") == "finished":
+                line += (f"  converged={r.get('converged')}"
+                         f" rounds={r.get('rounds')}")
+            if r.get("queue_wait_s") is not None:
+                line += f"  queue_wait={r['queue_wait_s']:.2f}s"
+            if r.get("batch"):
+                line += f"  batch={r['batch']}"
             line += f"  ({r['source']})"
             out.write(line + "\n")
 

@@ -96,6 +96,10 @@ def build_manifest(
         "jax_version": jax.__version__,
         "backend": backend or jax.default_backend(),
         "num_devices": int(num_devices),
+        # serve/: the daemon's request id and admission verdict for runs
+        # executed through the run daemon; None for direct CLI runs
+        "request_id": getattr(tel, "run_id", None),
+        "admission": getattr(tel, "admission", None),
         "config": config_doc(cfg),
         "topology": {
             "kind": topo.kind,
@@ -171,6 +175,9 @@ def build_manifest(
             "algorithm": result.algorithm,
             "estimate_error": None if err is None else float(err),
             "checkpoints": list(result.checkpoints),
+            # "drain" when a graceful stop ended the run early (the serve
+            # worker's SIGTERM path); None for normally-finished runs
+            "stopped": getattr(result, "stopped", None),
         }
     if error is not None:
         doc["error"] = error

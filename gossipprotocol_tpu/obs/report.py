@@ -238,6 +238,18 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
             f"gossipprotocol_tpu {manifest.get('package_version', '?')}, "
             f"jax {manifest.get('jax_version', '?')}]\n"
         )
+        if manifest.get("request_id"):
+            adm = manifest.get("admission") or {}
+            line = f"request: {manifest['request_id']} (daemon-executed"
+            if adm.get("verdict"):
+                line += f", admission {adm['verdict']}"
+            if adm.get("queue_depth") is not None:
+                line += f", queue depth {adm['queue_depth']}"
+            out.write(line + ")\n")
+        do = manifest.get("daemon_outcome")
+        if do:
+            out.write(f"daemon outcome: {do.get('event')} — "
+                      f"{do.get('reason')}\n")
         if manifest.get("resume"):
             r = manifest["resume"]
             out.write(f"resumed: from {r.get('from')} at round {r.get('round')}\n")
@@ -250,6 +262,8 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
                 f"{result.get('wall_ms', 0.0):.1f} ms run"
                 f" + {result.get('compile_ms', 0.0):.1f} ms compile"
                 + (f", estimate error {err:.3e}" if err is not None else "")
+                + ("  [drained]" if result.get("stopped") == "drain"
+                   else "")
                 + "\n"
             )
 

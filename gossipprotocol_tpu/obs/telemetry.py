@@ -39,6 +39,30 @@ from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
 COUNTER_TOTAL_FIELDS = ("sent", "delivered", "dropped")
 
 
+class TelemetryDirCollision(ValueError):
+    """The target dir already holds another run's ``run.json``.
+
+    Raised (collision="refuse", the default) instead of silently
+    appending this run's events into a different run's record. The serve
+    daemon passes collision="uniquify" to suffix the dir instead.
+    """
+
+
+def _manifest_run_id(out_dir: str):
+    """The ``request_id`` of an existing ``run.json`` in ``out_dir``;
+    None when there is no manifest; the string "<unreadable>" when one
+    exists but cannot be parsed (treated as a different run — fail
+    closed)."""
+    path = os.path.join(out_dir, "run.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            return json.load(fh).get("request_id") or "<unidentified>"
+    except (OSError, json.JSONDecodeError):
+        return "<unreadable>"
+
+
 class _Span:
     """Handle yielded by :meth:`Telemetry.span`; ``set()`` adds attrs late."""
 
@@ -92,13 +116,40 @@ class Telemetry:
     prediction = None  # obs.predict round prediction, set by the driver
     profile_dir = None  # jax.profiler trace dir when --profile-dir is set
     sweep = None  # sweep rollup (lanes, per-lane records), set by _drive_sweep
+    admission = None  # serve admission verdict doc, set by the CLI/daemon
 
     def __init__(self, out_dir: str, *, counters: bool = True,
                  traces: Optional[bool] = None,
                  trace_cap: Optional[int] = None,
                  resources: Optional[bool] = None,
-                 attribution: Optional[bool] = None):
+                 attribution: Optional[bool] = None,
+                 run_id: Optional[str] = None,
+                 collision: str = "refuse"):
         self.dir = os.path.abspath(out_dir)
+        self.run_id = run_id
+        if run_id is not None:
+            # collision guard: a dir already holding a DIFFERENT run's
+            # manifest must not silently accumulate this run's events.
+            # Only guarded when the caller identifies the run (the serve
+            # daemon always does); anonymous CLI runs keep the historical
+            # overwrite-on-reuse behavior.
+            existing = _manifest_run_id(self.dir)
+            if existing is not None and existing != run_id:
+                if collision == "uniquify":
+                    base, n = self.dir, 2
+                    while True:
+                        cand = f"{base}-{n}"
+                        ex = _manifest_run_id(cand)
+                        if ex is None or ex == run_id:
+                            self.dir = cand
+                            break
+                        n += 1
+                else:
+                    raise TelemetryDirCollision(
+                        f"telemetry dir {self.dir} already holds run.json "
+                        f"from a different run (request_id {existing!r}, "
+                        f"this run is {run_id!r}) — pick a fresh dir, or "
+                        "pass collision='uniquify'")
         os.makedirs(self.dir, exist_ok=True)
         self.counters_on = bool(counters)
         self.traces_on = bool(counters if traces is None else traces)
@@ -381,6 +432,8 @@ class NullTelemetry:
     prediction = None
     profile_dir = None
     sweep = None
+    admission = None
+    run_id = None
     shard_totals = None
     dir = None
 

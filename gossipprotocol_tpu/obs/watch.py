@@ -41,6 +41,9 @@ def _frame(data: Dict[str, Any], out: TextIO) -> bool:
     result = (manifest or {}).get("result")
     chunked = [r for r in metrics if "round" in r]
     last = chunked[-1] if chunked else {}
+    rid = (manifest or {}).get("request_id")
+    if rid:
+        out.write(f"request {rid} (daemon-executed)\n")
     rnd = (result or {}).get("rounds", last.get("round", 0))
     out.write(f"round {rnd}")
     if result is not None:

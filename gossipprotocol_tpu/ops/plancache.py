@@ -20,6 +20,7 @@ never leaves a truncated cache entry behind.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -55,6 +56,51 @@ def _provenance(build_s: float, build_workers: int) -> dict:
         "build_workers": int(build_workers),
         "host_cpus": os.cpu_count(),
     }
+
+
+@contextlib.contextmanager
+def _single_flight(path: str):
+    """Advisory build lock for one cache entry: concurrent builders of
+    the same topology (a daemon's warm-up racing a fresh worker, two
+    sweep lanes on one host) serialize on ``<entry>.npz.lock`` so the
+    O(E) plan build runs once and everyone else loads the result.
+
+    Yields the seconds spent waiting for the lock (0.0 when acquired
+    immediately, None when locking is unavailable — no fcntl, or an
+    unwritable cache dir — in which case behavior degrades to the old
+    race: both sides build, last save wins, entries are bitwise equal).
+    The ``.lock`` suffix keeps these files invisible to
+    ``_evict_over_budget`` (whose family filter requires ``.npz``).
+    """
+    try:
+        import fcntl
+    except ImportError:  # non-POSIX host
+        yield None
+        return
+    lock_path = path + ".lock"
+    try:
+        os.makedirs(os.path.dirname(lock_path) or ".", exist_ok=True)
+        fh = open(lock_path, "a")
+    except OSError:
+        yield None
+        return
+    try:
+        wait_s = 0.0
+        try:
+            fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            t0 = time.perf_counter()
+            fcntl.flock(fh, fcntl.LOCK_EX)
+            wait_s = time.perf_counter() - t0
+        try:
+            yield wait_s
+        finally:
+            try:
+                fcntl.flock(fh, fcntl.LOCK_UN)
+            except OSError:
+                pass
+    finally:
+        fh.close()
 
 
 def entry_provenance(path: str) -> Optional[dict]:
@@ -232,22 +278,34 @@ def routed_delivery_cached(topo, cache_dir: Optional[str] = None,
             progress(f"routed delivery: plan cache hit ({path})"
                      f"{_provenance_note(path)}")
         return (to_device(rd) if device else rd), "hit"
-    t0 = time.perf_counter()
-    rd = build_routed_delivery(topo, progress=progress, device=False)
-    prov = _provenance(time.perf_counter() - t0, build_workers=1)
-    try:
-        save(rd, path, provenance=prov)
-        _evict_over_budget(cache_dir, keep=path)
-        if progress:
-            progress(f"routed delivery: plan cached ({path}); "
-                     f"built in {prov['build_s']}s")
-    except OSError as e:
-        # a full disk / read-only cache dir must not cost the user the
-        # build it just paid for — degrade to uncached, loudly
-        import warnings
+    with _single_flight(path) as wait_s:
+        if wait_s:
+            # another process built this entry while we waited
+            rd = load(path)
+            if rd is not None:
+                if progress:
+                    progress(f"routed delivery: plan cache hit after "
+                             f"single-flight wait ({wait_s:.2f}s; {path})"
+                             f"{_provenance_note(path)}")
+                return (to_device(rd) if device else rd), "hit"
+        t0 = time.perf_counter()
+        rd = build_routed_delivery(topo, progress=progress, device=False)
+        prov = _provenance(time.perf_counter() - t0, build_workers=1)
+        if wait_s:
+            prov["single_flight_wait_s"] = round(wait_s, 3)
+        try:
+            save(rd, path, provenance=prov)
+            _evict_over_budget(cache_dir, keep=path)
+            if progress:
+                progress(f"routed delivery: plan cached ({path}); "
+                         f"built in {prov['build_s']}s")
+        except OSError as e:
+            # a full disk / read-only cache dir must not cost the user
+            # the build it just paid for — degrade to uncached, loudly
+            import warnings
 
-        warnings.warn(f"routed plan cache write failed ({e}); "
-                      "continuing uncached")
+            warnings.warn(f"routed plan cache write failed ({e}); "
+                          "continuing uncached")
     return (to_device(rd) if device else rd), "miss"
 
 
@@ -348,20 +406,31 @@ def pallas_delivery_cached(topo, cache_dir: Optional[str] = None,
             progress(f"pallas delivery: plan cache hit ({path})"
                      f"{_provenance_note(path)}")
         return (pallas_to_device(pd) if device else pd), "hit"
-    t0 = time.perf_counter()
-    pd = build_pallas_delivery(topo, progress=progress, device=False)
-    prov = _provenance(time.perf_counter() - t0, build_workers=1)
-    try:
-        save_pallas(pd, path, provenance=prov)
-        _evict_over_budget(cache_dir, keep=path)
-        if progress:
-            progress(f"pallas delivery: plan cached ({path}); "
-                     f"built in {prov['build_s']}s")
-    except OSError as e:
-        import warnings
+    with _single_flight(path) as wait_s:
+        if wait_s:
+            pd = load_pallas(path)
+            if pd is not None:
+                if progress:
+                    progress(f"pallas delivery: plan cache hit after "
+                             f"single-flight wait ({wait_s:.2f}s; {path})"
+                             f"{_provenance_note(path)}")
+                return (pallas_to_device(pd) if device else pd), "hit"
+        t0 = time.perf_counter()
+        pd = build_pallas_delivery(topo, progress=progress, device=False)
+        prov = _provenance(time.perf_counter() - t0, build_workers=1)
+        if wait_s:
+            prov["single_flight_wait_s"] = round(wait_s, 3)
+        try:
+            save_pallas(pd, path, provenance=prov)
+            _evict_over_budget(cache_dir, keep=path)
+            if progress:
+                progress(f"pallas delivery: plan cached ({path}); "
+                         f"built in {prov['build_s']}s")
+        except OSError as e:
+            import warnings
 
-        warnings.warn(f"pallas plan cache write failed ({e}); "
-                      "continuing uncached")
+            warnings.warn(f"pallas plan cache write failed ({e}); "
+                          "continuing uncached")
     return (pallas_to_device(pd) if device else pd), "miss"
 
 
@@ -478,24 +547,36 @@ def shard_deliveries_cached(topo, n_padded: int, num_shards: int,
             progress(f"sharded routed delivery: plan cache hit ({path})"
                      f"{_provenance_note(path)}")
         return stacked, "hit"
-    t0 = time.perf_counter()
-    stacked = build_shard_deliveries(topo, n_padded, num_shards,
-                                     progress=progress,
-                                     build_workers=build_workers)
-    prov = _provenance(time.perf_counter() - t0,
-                       resolve_build_workers(build_workers, num_shards))
-    try:
-        save_shards(stacked, path, provenance=prov)
-        _evict_over_budget(cache_dir, keep=path)
-        if progress:
-            progress(f"sharded routed delivery: plans cached ({path}); "
-                     f"built in {prov['build_s']}s with "
-                     f"{prov['build_workers']} workers")
-    except OSError as e:
-        import warnings
+    with _single_flight(path) as wait_s:
+        if wait_s:
+            stacked = load_shards(path)
+            if stacked is not None:
+                if progress:
+                    progress(f"sharded routed delivery: plan cache hit "
+                             f"after single-flight wait ({wait_s:.2f}s; "
+                             f"{path}){_provenance_note(path)}")
+                return stacked, "hit"
+        t0 = time.perf_counter()
+        stacked = build_shard_deliveries(topo, n_padded, num_shards,
+                                         progress=progress,
+                                         build_workers=build_workers)
+        prov = _provenance(
+            time.perf_counter() - t0,
+            resolve_build_workers(build_workers, num_shards))
+        if wait_s:
+            prov["single_flight_wait_s"] = round(wait_s, 3)
+        try:
+            save_shards(stacked, path, provenance=prov)
+            _evict_over_budget(cache_dir, keep=path)
+            if progress:
+                progress(f"sharded routed delivery: plans cached "
+                         f"({path}); built in {prov['build_s']}s with "
+                         f"{prov['build_workers']} workers")
+        except OSError as e:
+            import warnings
 
-        warnings.warn(f"sharded plan cache write failed ({e}); "
-                      "continuing uncached")
+            warnings.warn(f"sharded plan cache write failed ({e}); "
+                          "continuing uncached")
     return stacked, "miss"
 
 
@@ -615,24 +696,36 @@ def shard_push_deliveries_cached(topo, n_padded: int, num_shards: int,
             progress(f"push routed delivery: plan cache hit ({path})"
                      f"{_provenance_note(path)}")
         return stacked, "hit"
-    t0 = time.perf_counter()
-    stacked = build_shard_push_deliveries(topo, n_padded, num_shards,
-                                          progress=progress,
-                                          build_workers=build_workers)
-    prov = _provenance(time.perf_counter() - t0,
-                       resolve_build_workers(build_workers, num_shards))
-    try:
-        save_push_shards(stacked, path, provenance=prov)
-        _evict_over_budget(cache_dir, keep=path)
-        if progress:
-            progress(f"push routed delivery: plans cached ({path}); "
-                     f"built in {prov['build_s']}s with "
-                     f"{prov['build_workers']} workers")
-    except OSError as e:
-        import warnings
+    with _single_flight(path) as wait_s:
+        if wait_s:
+            stacked = load_push_shards(path)
+            if stacked is not None:
+                if progress:
+                    progress(f"push routed delivery: plan cache hit "
+                             f"after single-flight wait ({wait_s:.2f}s; "
+                             f"{path}){_provenance_note(path)}")
+                return stacked, "hit"
+        t0 = time.perf_counter()
+        stacked = build_shard_push_deliveries(topo, n_padded, num_shards,
+                                              progress=progress,
+                                              build_workers=build_workers)
+        prov = _provenance(
+            time.perf_counter() - t0,
+            resolve_build_workers(build_workers, num_shards))
+        if wait_s:
+            prov["single_flight_wait_s"] = round(wait_s, 3)
+        try:
+            save_push_shards(stacked, path, provenance=prov)
+            _evict_over_budget(cache_dir, keep=path)
+            if progress:
+                progress(f"push routed delivery: plans cached ({path}); "
+                         f"built in {prov['build_s']}s with "
+                         f"{prov['build_workers']} workers")
+        except OSError as e:
+            import warnings
 
-        warnings.warn(f"push plan cache write failed ({e}); "
-                      "continuing uncached")
+            warnings.warn(f"push plan cache write failed ({e}); "
+                          "continuing uncached")
     return stacked, "miss"
 
 

@@ -1,0 +1,30 @@
+"""Supervised multi-tenant run daemon (``python -m gossipprotocol_tpu serve``).
+
+One persistent process holds the warm caches (the routed plan cache and
+the persistent XLA compile cache are shared on disk, so every worker it
+spawns starts warm) and executes run requests from a crash-durable
+journal:
+
+* :mod:`.journal`    — queue-dir layout + the append-only state journal
+  every request transition lands in (the durable record replayed on
+  restart).
+* :mod:`.admission`  — parse/validate request documents and refuse
+  over-capacity or over-budget work *before any device work*, with the
+  same message text the CLI preflight prints.
+* :mod:`.supervisor` — the daemon loop: dispatch, per-request wall-clock
+  watchdog, sweep auto-batching, infra-failure retry with bench.py's
+  exponential backoff, SIGTERM drain, journal replay on restart.
+* :mod:`.worker`     — the per-request subprocess entry point: installs
+  the SIGTERM drain hook, runs the plain CLI in-process (daemon-executed
+  runs are bitwise the standalone CLI runs by construction), and maps
+  outcomes to supervisor-visible exit codes.
+* :mod:`.client`     — submit/status: atomic request drop-off into the
+  queue dir and journal-derived status, also served over the optional
+  HTTP surface.
+
+AOT ``jax.export`` warm-start (compiled programs surviving daemon
+restarts in-process) is a deliberate follow-up; the robustness contract
+lands first.
+"""
+
+from gossipprotocol_tpu.serve.journal import Journal, QueuePaths  # noqa: F401

@@ -1,0 +1,271 @@
+"""Admission control: refuse bad/over-budget requests before device work.
+
+A request document is JSON:
+
+.. code-block:: json
+
+    {
+      "argv": ["4096", "line", "push-sum", "--predicate", "global"],
+      "round_budget": 2000,
+      "wall_budget_s": 120,
+      "checkpoint_every": 4
+    }
+
+``argv`` is exactly the standalone CLI surface — a daemon-executed run
+IS a CLI run (the worker calls ``cli.main``), which is what makes
+daemon results bitwise-identical to standalone runs. The per-request
+resource knobs the daemon owns (telemetry dir, checkpoint dir, resume
+chain, metrics file, sweep plan) are refused inside ``argv`` and
+expressed through the three request fields instead.
+
+Admission is pure host work, strictly before any device work:
+
+1. malformed document / argv → refusal with a pinned message;
+2. topology + config build (same construction path as the CLI, so
+   config rejections carry the CLI's own messages);
+3. ``obs/capacity.py`` preflight — refusal text is byte-identical to
+   what the CLI preflight prints (it *is* the same ``CapacityError``);
+4. ``obs/predict.py`` round estimate vs the request's ``round_budget``
+   — an analytically-predicted blowout is refused up front instead of
+   burning its whole budget on device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import io
+import json
+from typing import Any, Dict, List, Optional
+
+# argv flags the daemon owns per-request; a request naming one is
+# malformed (the queue dir layout, not the client, decides these paths)
+MANAGED_FLAGS = (
+    "--telemetry-dir", "--checkpoint-dir", "--checkpoint-every",
+    "--resume", "--auto-resume", "--restarted", "--metrics-out",
+    "--round-budget", "--profile-dir", "--sweep", "--sweep-seeds",
+    "--request-id", "--admission-json",
+)
+
+MSG_NOT_JSON = "request invalid: not valid JSON ({err})"
+MSG_NOT_OBJECT = "request invalid: not a JSON object"
+MSG_BAD_ARGV = "request invalid: 'argv' must be a non-empty list of strings"
+MSG_MANAGED = ("request invalid: {flag} is daemon-managed — use the "
+               "request fields (round_budget, wall_budget_s, "
+               "checkpoint_every) instead")
+MSG_BAD_FIELD = "request invalid: {field!r} must be {want}"
+MSG_OVER_BUDGET = ("over budget: predicted {predicted} rounds exceeds the "
+                   "request round_budget {budget} ({model}, {confidence}) "
+                   "— raise the budget, relax the tolerance, or drop the "
+                   "field")
+
+
+class RequestError(ValueError):
+    """A malformed request document; str() is the refusal message."""
+
+
+@dataclasses.dataclass
+class Admitted:
+    """An admitted request: the parsed argv namespace rides along so the
+    supervisor can compute sweep-batch compatibility without re-parsing."""
+
+    doc: Dict[str, Any]          # normalized request document
+    args: Any                    # argparse namespace of doc["argv"]
+    verdict_doc: Dict[str, Any]  # json-able admission record
+
+
+@dataclasses.dataclass
+class Refused:
+    reason: str
+    verdict_doc: Dict[str, Any]
+
+
+def parse_request_text(text: str) -> Dict[str, Any]:
+    """Request file bytes -> normalized doc; raises :class:`RequestError`
+    with the pinned malformed-request messages."""
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise RequestError(MSG_NOT_JSON.format(err=e))
+    return normalize_request(doc)
+
+
+def normalize_request(doc: Any) -> Dict[str, Any]:
+    if not isinstance(doc, dict):
+        raise RequestError(MSG_NOT_OBJECT)
+    argv = doc.get("argv")
+    if (not isinstance(argv, list) or not argv
+            or not all(isinstance(a, str) for a in argv)):
+        raise RequestError(MSG_BAD_ARGV)
+    for a in argv:
+        flag = a.split("=", 1)[0]
+        if flag in MANAGED_FLAGS:
+            raise RequestError(MSG_MANAGED.format(flag=flag))
+    out: Dict[str, Any] = {"argv": list(argv)}
+    rb = doc.get("round_budget")
+    if rb is not None:
+        if isinstance(rb, bool) or not isinstance(rb, int) or rb < 1:
+            raise RequestError(MSG_BAD_FIELD.format(
+                field="round_budget", want="a positive integer"))
+        out["round_budget"] = rb
+    wb = doc.get("wall_budget_s")
+    if wb is not None:
+        if isinstance(wb, bool) or not isinstance(wb, (int, float)) or wb <= 0:
+            raise RequestError(MSG_BAD_FIELD.format(
+                field="wall_budget_s", want="a positive number"))
+        out["wall_budget_s"] = float(wb)
+    ce = doc.get("checkpoint_every")
+    if ce is not None:
+        if isinstance(ce, bool) or not isinstance(ce, int) or ce < 1:
+            raise RequestError(MSG_BAD_FIELD.format(
+                field="checkpoint_every", want="a positive integer"))
+        out["checkpoint_every"] = ce
+    return out
+
+
+def _parse_argv(argv: List[str]):
+    """argparse the request argv; argparse's usage errors (SystemExit 2)
+    become refusals carrying argparse's own message line."""
+    from gossipprotocol_tpu.cli import build_parser
+
+    err = io.StringIO()
+    try:
+        with contextlib.redirect_stderr(err):
+            return build_parser().parse_args(argv)
+    except SystemExit:
+        lines = [ln for ln in err.getvalue().strip().splitlines() if ln]
+        raise RequestError(
+            "request invalid: " + (lines[-1] if lines else "bad argv"))
+
+
+def evaluate(doc: Dict[str, Any], *, queue_depth: int = 0):
+    """Admission decision for a normalized request document.
+
+    Returns :class:`Admitted` or :class:`Refused`. Pure host work — the
+    topology and config are built (and discarded) exactly the way the
+    CLI builds them, so every refusal message here matches what the same
+    argv would print standalone.
+    """
+    import time as _time
+
+    verdict: Dict[str, Any] = {
+        "kind": "admission",
+        "ts": round(_time.time(), 3),
+        "queue_depth": int(queue_depth),
+        "round_budget": doc.get("round_budget"),
+        "wall_budget_s": doc.get("wall_budget_s"),
+    }
+
+    def refuse(reason: str) -> Refused:
+        verdict.update(verdict="refused", reason=reason)
+        return Refused(reason, verdict)
+
+    try:
+        args = _parse_argv(doc["argv"])
+    except RequestError as e:
+        return refuse(str(e))
+
+    from gossipprotocol_tpu.cli import (
+        _ALGO_ALIASES, _build_config, _build_run_topology,
+    )
+
+    algo = _ALGO_ALIASES.get(args.algorithm.lower())
+    if algo is None:
+        return refuse(f"option invalid: unknown algorithm "
+                      f"{args.algorithm!r} (valid: gossip, push-sum)")
+    try:
+        topo, alert_quorum = _build_run_topology(args)
+    except ValueError as e:
+        return refuse(str(e))
+
+    from gossipprotocol_tpu.utils import faults
+
+    try:
+        schedule = faults.build_schedule(
+            topo.num_nodes, plan_file=args.fault_plan,
+            fail_fraction=args.fail_fraction, fail_round=args.fail_round,
+            revive_round=args.revive_round, drop_prob=args.drop_prob,
+            drop_window=(tuple(args.drop_window) if args.drop_window
+                         else None),
+            seed=args.seed, max_rounds=args.max_rounds,
+        )
+    except (ValueError, OSError) as e:
+        return refuse(f"fault schedule invalid: {e}")
+
+    import jax.numpy as jnp
+
+    try:
+        cfg = _build_config(args, algo, schedule, jnp,
+                            alert_quorum=alert_quorum)
+    except ValueError as e:
+        return refuse(str(e))
+
+    # capacity preflight: byte-identical refusal text to the CLI's own
+    # preflight (it IS the same CapacityError)
+    from gossipprotocol_tpu.obs.capacity import CapacityError, preflight
+
+    try:
+        estimate = preflight(topo, cfg, args.devices)
+    except CapacityError as e:
+        return refuse(str(e))
+    if estimate is not None:
+        verdict["capacity"] = estimate
+
+    # analytic round estimate vs the request budget: a run the spectrum
+    # says cannot finish inside its budget is refused before it burns it
+    budget = doc.get("round_budget")
+    if budget is not None:
+        from gossipprotocol_tpu.obs.predict import maybe_predict_rounds
+
+        pred = maybe_predict_rounds(topo, cfg)
+        if pred is not None:
+            verdict["prediction"] = {
+                k: pred.get(k) for k in
+                ("model", "confidence", "predicted_rounds", "gamma")
+            }
+            if (pred.get("confidence") == "analytic"
+                    and int(pred["predicted_rounds"]) > int(budget)):
+                return refuse(MSG_OVER_BUDGET.format(
+                    predicted=pred["predicted_rounds"], budget=budget,
+                    model=pred.get("model"),
+                    confidence=pred.get("confidence")))
+
+    verdict["verdict"] = "admitted"
+    return Admitted(doc, args, verdict)
+
+
+def batch_key(doc: Dict[str, Any], args) -> str:
+    """Requests sharing this key may batch into one sweep program: every
+    config field except the PRNG seed, plus the daemon-level budgets,
+    must match (the seed becomes the sweep's zip axis)."""
+    d = dict(vars(args))
+    d.pop("seed", None)
+    return json.dumps(
+        {"args": {k: d[k] for k in sorted(d)},
+         "round_budget": doc.get("round_budget"),
+         "wall_budget_s": doc.get("wall_budget_s")},
+        sort_keys=True, default=str)
+
+
+def sweepable(doc: Dict[str, Any], args) -> bool:
+    """Host-side mirror of ``sweep/engine._validate_envelope`` for the
+    auto-batcher: only configs the lane engine carries may batch; the
+    engine's own validation stays the authority (a miss here just means
+    serial execution, a false positive falls back after its loud exit)."""
+    algo = args.algorithm.lower().replace("_", "-").replace(" ", "-")
+    return (
+        doc.get("checkpoint_every") is None
+        and args.workload == "avg"
+        and algo in ("gossip", "push-sum")
+        and (algo != "push-sum" or args.fanout == "one")
+        and args.delivery in ("scatter", "invert")
+        and args.accel == "off"
+        and args.devices == 1
+        and args.event_plan is None and args.churn is None
+        and args.value_faults is None
+        and args.fail_fraction == 0.0 and args.revive_round is None
+        and args.fault_plan is None
+        and args.repair == "off"
+        and args.sentinel == "off"
+        and args.semantics == "intended"
+    )

@@ -1,0 +1,221 @@
+"""Client side of the queue: atomic submit + journal-derived status.
+
+``submit`` drops a request document into ``QUEUE_DIR/incoming/`` with
+tmp+rename (the daemon never sees a torn file) and prints the request
+id; ``--wait`` then tails the journal until the request reaches a
+terminal phase and exits with the run's own outcome code. ``status``
+renders the journal — it never talks to the daemon process, so it works
+on a live queue, a drained one, and a crashed one alike.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from gossipprotocol_tpu.serve import journal as journal_mod
+
+# submit --wait exit codes per terminal phase (finished maps by
+# converged); drained mirrors the worker's "paused, resumable" code
+_PHASE_RC = {"refused": 2, "failed": 1, "timeout": 1, "interrupted": 1,
+             "over_budget": 1, "drained": 3}
+
+
+def new_request_id() -> str:
+    return "req-" + uuid.uuid4().hex[:12]
+
+
+def submit(queue_dir: str, doc: Dict[str, Any],
+           request_id: Optional[str] = None) -> str:
+    """Atomically drop a request document; returns its id. The document
+    is NOT validated here — admission is the daemon's job, so a bad
+    document still lands and is refused with a journaled message."""
+    paths = journal_mod.QueuePaths(os.path.abspath(queue_dir))
+    paths.ensure()
+    rid = request_id or new_request_id()
+    doc = dict(doc)
+    doc.setdefault("submitted_epoch", round(time.time(), 3))
+    target = os.path.join(paths.incoming, f"{rid}.json")
+    tmp = target + f".tmp{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, target)
+    return rid
+
+
+def request_state(queue_dir: str, rid: str
+                  ) -> Optional[journal_mod.RequestState]:
+    paths = journal_mod.QueuePaths(os.path.abspath(queue_dir))
+    states = journal_mod.replay(journal_mod.read_journal(paths.journal))
+    st = states.get(rid)
+    if st is None and os.path.exists(
+            os.path.join(paths.incoming, f"{rid}.json")):
+        return journal_mod.RequestState(rid)  # submitted, not yet seen
+    return st
+
+
+def wait(queue_dir: str, rid: str, timeout_s: Optional[float] = None,
+         poll_s: float = 0.3, out=None) -> int:
+    """Block until ``rid`` reaches a terminal (or drained) phase; returns
+    the mapped exit code. Progress transitions stream to ``out``."""
+    out = out or sys.stderr
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    last_phase = None
+    while True:
+        st = request_state(queue_dir, rid)
+        phase = st.phase if st is not None else "submitted"
+        if phase != last_phase:
+            print(f"{rid}: {phase}", file=out)
+            last_phase = phase
+        if st is not None and (st.terminal or phase == "drained"):
+            return _finish_code(st, out)
+        if deadline is not None and time.monotonic() > deadline:
+            print(f"{rid}: wait timed out in phase {phase!r}", file=out)
+            return 1
+        time.sleep(poll_s)
+
+
+def _finish_code(st: journal_mod.RequestState, out) -> int:
+    last = st.last
+    phase = st.phase
+    if phase == "refused":
+        print(last.get("reason", "refused"), file=sys.stderr)
+        return 2
+    if phase == "finished":
+        conv = bool(last.get("converged"))
+        rounds = last.get("rounds")
+        print(f"{st.id}: {'converged' if conv else 'NOT converged'}"
+              f" in {rounds} rounds", file=out)
+        return 0 if conv else 1
+    if phase in ("failed", "timeout", "interrupted", "over_budget"):
+        reason = last.get("reason") or phase
+        print(f"{st.id}: {reason}", file=sys.stderr)
+    return _PHASE_RC.get(phase, 1)
+
+
+def _render_status(states: List[journal_mod.RequestState], out) -> None:
+    for st in states:
+        last = st.last
+        line = f"{st.id}  {st.phase}"
+        if st.phase == "refused":
+            line += f"  ({last.get('reason')})"
+        elif st.phase == "finished":
+            line += (f"  converged={last.get('converged')}"
+                     f" rounds={last.get('rounds')}")
+        elif st.phase in ("started", "batched"):
+            line += f"  pid={last.get('pid')}" if last.get("pid") else ""
+        wait_s = st.queue_wait_s
+        if wait_s is not None:
+            line += f"  queue_wait={wait_s:.2f}s"
+        print(line, file=out)
+
+
+def submit_main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    usage = ("usage: python -m gossipprotocol_tpu submit --queue-dir D "
+             "[--round-budget N] [--wall-budget-s S] "
+             "[--checkpoint-every K] [--wait [TIMEOUT_S]] -- <cli argv...>")
+    queue_dir = None
+    doc: Dict[str, Any] = {}
+    do_wait = False
+    wait_timeout: Optional[float] = None
+    run_argv: Optional[List[str]] = None
+    i = 0
+    try:
+        while i < len(argv):
+            a = argv[i]
+            if a == "--":
+                run_argv = argv[i + 1:]
+                break
+            elif a == "--queue-dir":
+                queue_dir = argv[i + 1]
+                i += 2
+            elif a == "--round-budget":
+                doc["round_budget"] = int(argv[i + 1])
+                i += 2
+            elif a == "--wall-budget-s":
+                doc["wall_budget_s"] = float(argv[i + 1])
+                i += 2
+            elif a == "--checkpoint-every":
+                doc["checkpoint_every"] = int(argv[i + 1])
+                i += 2
+            elif a == "--wait":
+                do_wait = True
+                if i + 1 < len(argv) and not argv[i + 1].startswith("-") \
+                        and argv[i + 1] != "--":
+                    wait_timeout = float(argv[i + 1])
+                    i += 2
+                else:
+                    i += 1
+            elif a in ("-h", "--help"):
+                print(usage)
+                return 0
+            else:
+                print(f"submit: unknown option {a!r}\n{usage}",
+                      file=sys.stderr)
+                return 2
+    except (IndexError, ValueError):
+        print(usage, file=sys.stderr)
+        return 2
+    if queue_dir is None or not run_argv:
+        print(usage, file=sys.stderr)
+        return 2
+    doc["argv"] = run_argv
+    rid = submit(queue_dir, doc)
+    print(f"submitted {rid}")
+    if do_wait:
+        return wait(queue_dir, rid, timeout_s=wait_timeout)
+    return 0
+
+
+def status_main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    usage = ("usage: python -m gossipprotocol_tpu status --queue-dir D "
+             "[REQUEST_ID] [--json]")
+    queue_dir = None
+    rid = None
+    as_json = False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--queue-dir":
+            if i + 1 >= len(argv):
+                print(usage, file=sys.stderr)
+                return 2
+            queue_dir = argv[i + 1]
+            i += 2
+        elif a == "--json":
+            as_json = True
+            i += 1
+        elif a in ("-h", "--help"):
+            print(usage)
+            return 0
+        else:
+            rid = a
+            i += 1
+    if queue_dir is None:
+        print(usage, file=sys.stderr)
+        return 2
+    paths = journal_mod.QueuePaths(os.path.abspath(queue_dir))
+    states = journal_mod.replay(journal_mod.read_journal(paths.journal))
+    if rid is not None:
+        st = states.get(rid)
+        if st is None:
+            print(f"status: unknown request {rid!r}", file=sys.stderr)
+            return 2
+        if as_json:
+            print(json.dumps(st.events, indent=2))
+        else:
+            _render_status([st], sys.stdout)
+        return 0
+    if as_json:
+        print(json.dumps({s.id: s.events for s in states.values()},
+                         indent=2))
+    else:
+        _render_status(list(states.values()), sys.stdout)
+    return 0

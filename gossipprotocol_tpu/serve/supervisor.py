@@ -1,0 +1,731 @@
+"""The run daemon: supervised multi-tenant execution over a queue dir.
+
+``python -m gossipprotocol_tpu serve --queue-dir D [--http PORT]`` runs
+one persistent supervisor that
+
+* **ingests** request files from ``D/incoming/`` (atomic client
+  drop-off) and runs :mod:`.admission` on each — over-capacity and
+  over-budget requests are refused *before any device work*, with the
+  CLI preflight's own message text journaled as the reason;
+* **dispatches** admitted requests as one worker subprocess each
+  (:mod:`.worker` runs the plain CLI in-process, so daemon-executed
+  runs are bitwise the standalone runs), auto-batching compatible
+  queued avg-workload requests into one sweep program when the lane
+  engine carries them;
+* **supervises**: a per-request wall-clock watchdog SIGKILLs hung
+  workers (journaled ``timeout``), round-budget blowouts land as
+  ``over_budget`` (the driver stops the run itself and says so in the
+  manifest), device-side infra failures retry with bench.py's
+  exponential backoff (``2.0 ** (attempt - 1)``), and a crashed or
+  refused run never takes the daemon down;
+* **drains** on SIGTERM: stop admitting, SIGTERM every worker (the
+  engine saves an off-cadence checkpoint at the next chunk boundary and
+  exits "drained"), SIGKILL whatever outlives the grace window, exit 0;
+* **recovers** on restart: the journal is replayed — checkpointed
+  mid-flight runs resume through the existing ``--auto-resume`` chain,
+  non-checkpointed ones are stamped ``interrupted``, queued ones are
+  re-admitted. The queue dir is the daemon's whole durable state.
+
+Warm caches are shared by construction: every worker inherits the
+daemon's environment, so the routed plan cache and the persistent XLA
+compile cache directories are hot across requests. In-process AOT
+``jax.export`` warm-start is the follow-up tracked in ROADMAP.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from gossipprotocol_tpu.serve import admission as adm_mod
+from gossipprotocol_tpu.serve import journal as journal_mod
+from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
+
+MSG_QUEUE_FULL = ("queue full: {depth} requests pending (max {max_queue}) "
+                  "— retry after the backlog drains")
+
+# bench.py's retry policy for device-side infra failures: attempt k
+# sleeps 2**(k-1) seconds, max _RETRY_ATTEMPTS attempts total
+DEFAULT_RETRY_ATTEMPTS = 3
+
+
+@dataclasses.dataclass
+class _Pending:
+    """An admitted request waiting for a worker slot."""
+
+    rid: str
+    doc: Dict[str, Any]
+    args: Any                       # argparse namespace (batch compat)
+    attempts: int = 0               # infra-failure retries consumed
+    no_batch: bool = False          # set after a batch went down with it
+    resume_dir: Optional[str] = None  # checkpoint dir to resume from
+    not_before: float = 0.0         # monotonic gate (retry backoff)
+
+
+@dataclasses.dataclass
+class _Running:
+    """One live worker subprocess (one request, or one sweep batch)."""
+
+    ids: List[str]                  # member request ids (1 unless batch)
+    proc: subprocess.Popen
+    started: float                  # monotonic spawn time
+    wall_budget_s: Optional[float]
+    log_fh: Any
+    pendings: List[_Pending]        # members, for retry/requeue
+    batch_id: Optional[str] = None
+    tel_dir: str = ""
+
+
+class Supervisor:
+    def __init__(self, queue_dir: str, *, poll_s: float = 0.2,
+                 max_queue: int = 64, max_workers: int = 4,
+                 drain_grace_s: float = 30.0,
+                 retry_attempts: int = DEFAULT_RETRY_ATTEMPTS,
+                 batching: bool = True, http_port: Optional[int] = None):
+        self.journal = journal_mod.Journal(queue_dir)
+        self.paths = self.journal.paths
+        self.poll_s = poll_s
+        self.max_queue = max_queue
+        self.max_workers = max(1, max_workers)
+        self.drain_grace_s = drain_grace_s
+        self.retry_attempts = max(1, retry_attempts)
+        self.batching = batching
+        self.http_port = http_port
+        self.pending: List[_Pending] = []
+        self.running: Dict[str, _Running] = {}
+        self._stop = False
+        self._httpd = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def run(self) -> int:
+        signal.signal(signal.SIGTERM, self._request_stop)
+        signal.signal(signal.SIGINT, self._request_stop)
+        self._recover()
+        if self.http_port is not None:
+            self._start_http()
+        self._log(f"serving queue {self.paths.root} "
+                  f"(pid {os.getpid()}, poll {self.poll_s}s)")
+        try:
+            while not self._stop:
+                self._ingest()
+                self._dispatch()
+                self._reap()
+                time.sleep(self.poll_s)
+            self._drain()
+        finally:
+            if self._httpd is not None:
+                self._httpd.shutdown()
+            self.journal.close()
+        return 0
+
+    def _request_stop(self, signum, frame) -> None:
+        self._stop = True
+
+    def _log(self, msg: str) -> None:
+        print(f"serve: {msg}", file=sys.stderr, flush=True)
+
+    # ------------------------------------------------------------------
+    # crash recovery: the journal is the whole truth
+
+    def _recover(self) -> None:
+        states = journal_mod.replay(self.journal.records())
+        for st in states.values():
+            if st.terminal:
+                continue
+            phase = st.phase
+            if phase in ("started", "batched"):
+                self._recover_inflight(st)
+            elif phase == "drained":
+                self._requeue_resumable(st, "drain checkpoint")
+            elif phase in ("accepted", "admitted", "recovered", "retry"):
+                self._requeue_queued(st)
+
+    def _recover_inflight(self, st: journal_mod.RequestState) -> None:
+        """A run the dead daemon had started. If its worker somehow
+        outlived the daemon, kill it (split-brain guard); then resume
+        from the newest checkpoint, or stamp ``interrupted`` when the
+        run never published one."""
+        started = st.first("started") or st.first("batched") or {}
+        self._kill_orphan(started.get("pid"))
+        ckpt_dir = self.paths.checkpoint_dir(st.id)
+        found = _latest_resumable(ckpt_dir)
+        if found is not None and st.phase == "started":
+            path, rnd = found
+            self._requeue_resumable(
+                st, f"checkpoint at round {rnd}", resume_round=rnd)
+            return
+        self.journal.append(
+            "interrupted", st.id,
+            reason="daemon died mid-run with no checkpoint to resume")
+        self._stamp_outcome(
+            st.id, "interrupted",
+            "daemon died mid-run with no checkpoint to resume",
+            tel_dir=started.get("telemetry_dir"))
+
+    def _requeue_resumable(self, st, what: str,
+                           resume_round: Optional[int] = None) -> None:
+        doc = self._load_request_doc(st.id)
+        if doc is None:
+            self.journal.append("failed", st.id,
+                                reason="request file lost from queue dir")
+            return
+        self.journal.append("recovered", st.id, resume=what,
+                            resume_round=resume_round)
+        self.pending.append(_Pending(
+            st.id, doc, args=None, no_batch=True,
+            resume_dir=self.paths.checkpoint_dir(st.id)))
+
+    def _requeue_queued(self, st) -> None:
+        doc = self._load_request_doc(st.id)
+        if doc is None:
+            self.journal.append("failed", st.id,
+                                reason="request file lost from queue dir")
+            return
+        self.journal.append("recovered", st.id, resume="re-queued")
+        # args=None → re-admitted at dispatch (capacity may have changed)
+        self.pending.append(_Pending(st.id, doc, args=None))
+
+    def _load_request_doc(self, rid: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.paths.request_file(rid)) as fh:
+                return adm_mod.normalize_request(json.load(fh))
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _kill_orphan(pid) -> None:
+        """SIGKILL a worker pid left over from the previous daemon — but
+        only after /proc confirms the pid still belongs to us (pids
+        recycle; killing a stranger is worse than a stray worker)."""
+        if not pid:
+            return
+        try:
+            with open(f"/proc/{int(pid)}/cmdline", "rb") as fh:
+                cmdline = fh.read()
+        except (OSError, ValueError):
+            return
+        if b"gossipprotocol_tpu" in cmdline:
+            try:
+                os.kill(int(pid), signal.SIGKILL)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+    # ingest: incoming/ -> accepted -> admission -> admitted | refused
+
+    def _ingest(self) -> None:
+        try:
+            names = sorted(os.listdir(self.paths.incoming))
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            rid = name[:-5]
+            src = os.path.join(self.paths.incoming, name)
+            depth = len(self.pending) + len(self.running)
+            if depth >= self.max_queue:
+                # admission control starts at the door: a full queue
+                # refuses before reading the request (429, in effect)
+                os.replace(src, self.paths.request_file(rid))
+                self.journal.append("accepted", rid)
+                reason = MSG_QUEUE_FULL.format(
+                    depth=depth, max_queue=self.max_queue)
+                self.journal.append("refused", rid, reason=reason)
+                self._log(f"{rid} refused: {reason}")
+                continue
+            os.replace(src, self.paths.request_file(rid))
+            self.journal.append("accepted", rid)
+            self._admit(rid, depth)
+
+    def _admit(self, rid: str, depth: int) -> None:
+        try:
+            with open(self.paths.request_file(rid)) as fh:
+                doc = adm_mod.parse_request_text(fh.read())
+        except adm_mod.RequestError as e:
+            self.journal.append("refused", rid, reason=str(e))
+            self._log(f"{rid} refused: {e}")
+            return
+        except OSError as e:
+            self.journal.append("refused", rid,
+                                reason=f"request unreadable: {e}")
+            return
+        decision = adm_mod.evaluate(doc, queue_depth=depth)
+        os.makedirs(self.paths.run_dir(rid), exist_ok=True)
+        _atomic_json(self.paths.admission_file(rid), decision.verdict_doc)
+        if isinstance(decision, adm_mod.Refused):
+            self.journal.append("refused", rid, reason=decision.reason)
+            self._log(f"{rid} refused: {decision.reason}")
+            return
+        self.journal.append("admitted", rid,
+                            round_budget=doc.get("round_budget"),
+                            wall_budget_s=doc.get("wall_budget_s"))
+        self.pending.append(_Pending(rid, doc, args=decision.args))
+
+    # ------------------------------------------------------------------
+    # dispatch: pending -> workers (auto-batched into sweep lanes)
+
+    def _dispatch(self) -> None:
+        now = time.monotonic()
+        ready = [p for p in self.pending if p.not_before <= now]
+        if not ready:
+            return
+        for p in [p for p in ready if p.args is None]:
+            # recovered request, not yet re-admitted in this daemon life
+            self.pending.remove(p)
+            decision = adm_mod.evaluate(
+                p.doc, queue_depth=len(self.pending) + len(self.running))
+            if isinstance(decision, adm_mod.Refused):
+                self.journal.append("refused", p.rid,
+                                    reason=decision.reason)
+                self._log(f"{p.rid} refused on re-admission: "
+                          f"{decision.reason}")
+                continue
+            p.args = decision.args
+            self.pending.append(p)
+        ready = [p for p in self.pending
+                 if p.not_before <= now and p.args is not None]
+        if self.batching:
+            groups: Dict[str, List[_Pending]] = {}
+            for p in ready:
+                if (p.no_batch or p.resume_dir is not None
+                        or not adm_mod.sweepable(p.doc, p.args)):
+                    continue
+                groups.setdefault(
+                    adm_mod.batch_key(p.doc, p.args), []).append(p)
+            for members in groups.values():
+                if len(members) >= 2 and len(self.running) < self.max_workers:
+                    self._spawn_batch(members)
+                    for p in members:
+                        self.pending.remove(p)
+                        ready.remove(p)
+        for p in list(ready):
+            if len(self.running) >= self.max_workers:
+                break
+            self._spawn_single(p)
+            self.pending.remove(p)
+
+    def _worker_cmd(self, argv: List[str]) -> List[str]:
+        return [sys.executable, "-m", "gossipprotocol_tpu.serve.worker",
+                "--"] + argv
+
+    def _spawn(self, run_id: str, argv: List[str], ids: List[str],
+               pendings: List[_Pending], wall_budget_s, tel_dir: str,
+               batch_id: Optional[str] = None) -> _Running:
+        os.makedirs(self.paths.run_dir(run_id), exist_ok=True)
+        log_fh = open(self.paths.worker_log(run_id), "a")
+        # no start_new_session: workers share the daemon's process group
+        # on purpose — a machine-crash simulation (killpg) takes both
+        # down, which is exactly the failure recovery must handle
+        proc = subprocess.Popen(
+            self._worker_cmd(argv), stdout=log_fh,
+            stderr=subprocess.STDOUT)
+        run = _Running(ids=ids, proc=proc, started=time.monotonic(),
+                       wall_budget_s=wall_budget_s, log_fh=log_fh,
+                       pendings=pendings, batch_id=batch_id,
+                       tel_dir=tel_dir)
+        self.running[run_id] = run
+        return run
+
+    def _spawn_single(self, p: _Pending) -> None:
+        rid = p.rid
+        doc = p.doc
+        tel_dir = self.paths.telemetry_dir(rid)
+        argv = list(doc["argv"])
+        argv += ["--telemetry-dir", tel_dir,
+                 "--request-id", rid,
+                 "--admission-json", self.paths.admission_file(rid)]
+        rb = doc.get("round_budget")
+        if rb is not None:
+            argv += ["--round-budget", str(rb)]
+        ce = doc.get("checkpoint_every")
+        if ce is not None:
+            argv += ["--checkpoint-dir", self.paths.checkpoint_dir(rid),
+                     "--checkpoint-every", str(ce)]
+        if p.resume_dir is not None or p.attempts:
+            from gossipprotocol_tpu.cli import resume_argv
+
+            resume_from = (p.resume_dir
+                           if _latest_resumable(p.resume_dir or "")
+                           else None)
+            attempts_left = max(0, self.retry_attempts - p.attempts - 1)
+            argv = resume_argv(argv, resume_from, attempts_left)
+        run = self._spawn(rid, argv, [rid], [p],
+                          doc.get("wall_budget_s"), tel_dir)
+        self.journal.append("started", rid, pid=run.proc.pid,
+                            argv=doc["argv"], telemetry_dir=tel_dir,
+                            attempt=p.attempts + 1,
+                            resumed=p.resume_dir is not None)
+        self._log(f"{rid} started (pid {run.proc.pid})")
+
+    def _spawn_batch(self, members: List[_Pending]) -> None:
+        """Fuse compatible single-seed requests into one sweep program:
+        lane i of the zip-mode seed axis is exactly member i's run."""
+        members = sorted(members, key=lambda p: p.rid)
+        batch_id = "batch-" + members[0].rid
+        doc0 = members[0].doc
+        seeds = [int(p.args.seed) for p in members]
+        run_dir = self.paths.run_dir(batch_id)
+        os.makedirs(run_dir, exist_ok=True)
+        plan_path = os.path.join(run_dir, "plan.json")
+        _atomic_json(plan_path, {"axes": {"seed": seeds}, "mode": "zip"})
+        tel_dir = self.paths.telemetry_dir(batch_id)
+        argv = list(doc0["argv"])
+        argv += ["--sweep", plan_path,
+                 "--telemetry-dir", tel_dir,
+                 "--request-id", batch_id]
+        rb = doc0.get("round_budget")
+        if rb is not None:
+            argv += ["--round-budget", str(rb)]
+        ids = [p.rid for p in members]
+        run = self._spawn(batch_id, argv, ids, members,
+                          doc0.get("wall_budget_s"), tel_dir,
+                          batch_id=batch_id)
+        for lane, p in enumerate(members):
+            self.journal.append("batched", p.rid, batch=batch_id,
+                                lane=lane, pid=run.proc.pid,
+                                telemetry_dir=tel_dir)
+        self._log(f"{batch_id} started: {len(members)} requests fused "
+                  f"into one sweep (pid {run.proc.pid})")
+
+    # ------------------------------------------------------------------
+    # reap: worker exits + the wall-clock watchdog
+
+    def _reap(self) -> None:
+        for run_id in list(self.running):
+            run = self.running[run_id]
+            rc = run.proc.poll()
+            if rc is None:
+                self._watchdog(run_id, run)
+                continue
+            del self.running[run_id]
+            run.log_fh.close()
+            self._settle(run_id, run, rc)
+
+    def _watchdog(self, run_id: str, run: _Running) -> None:
+        if run.wall_budget_s is None:
+            return
+        elapsed = time.monotonic() - run.started
+        if elapsed <= run.wall_budget_s:
+            return
+        run.proc.kill()
+        run.proc.wait()
+        del self.running[run_id]
+        run.log_fh.close()
+        reason = (f"wall budget {run.wall_budget_s}s exceeded "
+                  f"({elapsed:.1f}s elapsed) — worker killed")
+        for rid in run.ids:
+            self.journal.append("timeout", rid, reason=reason)
+            self._stamp_outcome(rid, "timeout", reason,
+                                tel_dir=run.tel_dir)
+        self._log(f"{run_id} timed out: {reason}")
+
+    def _settle(self, run_id: str, run: _Running, rc: int) -> None:
+        if rc in (0, 1):
+            self._settle_finished(run_id, run)
+        elif rc == 3:
+            self._settle_drained(run_id, run)
+        elif rc == 4:
+            self._settle_infra(run_id, run)
+        elif rc < 0:
+            reason = (f"worker killed by signal {-rc}"
+                      + (" after drain grace" if self._stop else ""))
+            event = "interrupted" if self._stop else "failed"
+            for rid in run.ids:
+                self.journal.append(event, rid, reason=reason)
+                self._stamp_outcome(rid, event, reason,
+                                    tel_dir=run.tel_dir)
+            self._log(f"{run_id}: {reason}")
+        else:
+            kind = ("bad request/config"
+                    if rc == 2 else "worker crashed")
+            reason = (f"{kind} (exit {rc}) — see "
+                      f"{self.paths.worker_log(run_id)}")
+            if run.batch_id is not None and rc == 2:
+                # the envelope mirror let a non-sweepable config through:
+                # fall back to serial execution, loudly
+                self._log(f"{run_id} batch failed admission into the "
+                          f"sweep engine; re-queueing members serially")
+                for p in run.pendings:
+                    p.no_batch = True
+                    self.journal.append("retry", p.rid,
+                                        reason="batch fell back to "
+                                               "serial execution")
+                    self.pending.append(p)
+                return
+            for rid in run.ids:
+                self.journal.append("failed", rid, reason=reason)
+            self._log(f"{run_id} failed: {reason}")
+
+    def _settle_finished(self, run_id: str, run: _Running) -> None:
+        manifest = _read_json(os.path.join(run.tel_dir, "run.json")) or {}
+        if run.batch_id is not None:
+            per_lane = ((manifest.get("sweep") or {}).get("per_lane")
+                        or [])
+            for lane, rid in enumerate(run.ids):
+                lr = per_lane[lane] if lane < len(per_lane) else {}
+                self.journal.append(
+                    "finished", rid, batch=run.batch_id, lane=lane,
+                    converged=bool(lr.get("converged")),
+                    rounds=lr.get("rounds"))
+            self._log(f"{run_id} finished "
+                      f"({len(run.ids)} lanes settled)")
+            return
+        rid = run.ids[0]
+        result = manifest.get("result") or {}
+        pred = manifest.get("prediction") or {}
+        if pred.get("over_budget"):
+            self.journal.append(
+                "over_budget", rid,
+                rounds=result.get("rounds"),
+                round_budget=run.pendings[0].doc.get("round_budget"),
+                reason=(f"stopped at its round budget after "
+                        f"{result.get('rounds')} rounds"))
+            self._log(f"{rid} over budget at round "
+                      f"{result.get('rounds')}")
+            return
+        self.journal.append("finished", rid,
+                            converged=bool(result.get("converged")),
+                            rounds=result.get("rounds"),
+                            wall_ms=result.get("wall_ms"))
+        self._log(f"{rid} finished (converged="
+                  f"{bool(result.get('converged'))})")
+
+    def _settle_drained(self, run_id: str, run: _Running) -> None:
+        has_ckpt = any(
+            _latest_resumable(self.paths.checkpoint_dir(rid))
+            for rid in run.ids)
+        for rid in run.ids:
+            self.journal.append("drained", rid, checkpointed=has_ckpt)
+        if not self._stop:
+            # a drain we did not ask for (stray SIGTERM): resume it
+            for p in run.pendings:
+                p.resume_dir = self.paths.checkpoint_dir(p.rid)
+                p.no_batch = True
+                self.pending.append(p)
+        self._log(f"{run_id} drained"
+                  f" (checkpoint {'saved' if has_ckpt else 'absent'})")
+
+    def _settle_infra(self, run_id: str, run: _Running) -> None:
+        for p in run.pendings:
+            p.attempts += 1
+            if p.attempts >= self.retry_attempts:
+                reason = (f"infra failure: {p.attempts} attempts "
+                          f"exhausted")
+                self.journal.append("failed", p.rid, reason=reason)
+                self._log(f"{p.rid} failed: {reason}")
+                continue
+            backoff = 2.0 ** (p.attempts - 1)  # bench.py's policy
+            p.not_before = time.monotonic() + backoff
+            p.no_batch = True
+            p.resume_dir = self.paths.checkpoint_dir(p.rid)
+            self.journal.append("retry", p.rid, attempt=p.attempts,
+                                backoff_s=backoff,
+                                reason="accelerator runtime died")
+            self.pending.append(p)
+            self._log(f"{p.rid} infra failure; retry "
+                      f"{p.attempts + 1}/{self.retry_attempts} in "
+                      f"{backoff:.0f}s")
+
+    # ------------------------------------------------------------------
+    # graceful degradation: SIGTERM drains in-flight runs
+
+    def _drain(self) -> None:
+        n = len(self.running)
+        self._log(f"SIGTERM: draining {n} in-flight run(s), grace "
+                  f"{self.drain_grace_s}s")
+        for run in self.running.values():
+            try:
+                run.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.drain_grace_s
+        while self.running and time.monotonic() < deadline:
+            self._reap()
+            if self.running:
+                time.sleep(min(self.poll_s, 0.1))
+        for run_id in list(self.running):
+            run = self.running.pop(run_id)
+            run.proc.kill()
+            run.proc.wait()
+            run.log_fh.close()
+            reason = (f"drain grace {self.drain_grace_s}s expired — "
+                      f"worker killed")
+            for rid in run.ids:
+                self.journal.append("interrupted", rid, reason=reason)
+                self._stamp_outcome(rid, "interrupted", reason,
+                                    tel_dir=run.tel_dir)
+            self._log(f"{run_id}: {reason}")
+        self._log("drain complete")
+
+    # ------------------------------------------------------------------
+    # outcome stamping (killed workers leave no manifest of their own)
+
+    def _stamp_outcome(self, rid: str, event: str, reason: str,
+                       tel_dir: Optional[str] = None) -> None:
+        tel_dir = tel_dir or self.paths.telemetry_dir(rid)
+        try:
+            os.makedirs(tel_dir, exist_ok=True)
+        except OSError:
+            return
+        path = os.path.join(tel_dir, "run.json")
+        doc = _read_json(path)
+        if doc is None:
+            doc = {"v": SCHEMA_VERSION, "kind": "run_manifest",
+                   "request_id": rid, "config": None, "result": None}
+        doc["error"] = reason
+        doc["daemon_outcome"] = {"event": event, "reason": reason,
+                                 "ts": round(time.time(), 3)}
+        try:
+            _atomic_json(path, doc)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    # optional HTTP surface (file queue stays the source of truth)
+
+    def _start_http(self) -> None:
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        sup = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, doc: Dict[str, Any]) -> None:
+                body = (json.dumps(doc) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"ok": True,
+                                      "pending": len(sup.pending),
+                                      "running": len(sup.running)})
+                    return
+                if self.path.startswith("/status/"):
+                    rid = self.path[len("/status/"):]
+                    states = journal_mod.replay(sup.journal.records())
+                    st = states.get(rid)
+                    if st is None:
+                        self._reply(404, {"error": "unknown request",
+                                          "id": rid})
+                        return
+                    code = 429 if st.phase == "refused" else 200
+                    self._reply(code, {
+                        "id": rid, "phase": st.phase,
+                        "verdict": st.verdict,
+                        "queue_wait_s": st.queue_wait_s,
+                        "last": st.last})
+                    return
+                self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/submit":
+                    self._reply(404, {"error": "not found"})
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length).decode("utf-8", "replace")
+                try:
+                    doc = json.loads(body) if body else None
+                except json.JSONDecodeError as e:
+                    self._reply(400, {"error": adm_mod.MSG_NOT_JSON
+                                      .format(err=e)})
+                    return
+                if not isinstance(doc, dict):
+                    self._reply(400, {"error": adm_mod.MSG_NOT_OBJECT})
+                    return
+                from gossipprotocol_tpu.serve import client
+
+                rid = client.submit(sup.paths.root, doc)
+                self._reply(202, {"id": rid})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.http_port),
+                                          Handler)
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True)
+        t.start()
+        self._log(f"http on 127.0.0.1:{self._httpd.server_address[1]}")
+
+
+def _latest_resumable(directory: str):
+    """(path, round) of the newest *readable* checkpoint, else None —
+    recovery must not promise a resume it cannot deliver."""
+    from gossipprotocol_tpu.utils import checkpoint as ckpt_mod
+
+    if not directory:
+        return None
+    return ckpt_mod.latest_resumable(directory)
+
+
+def _atomic_json(path: str, doc: Any) -> None:
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m gossipprotocol_tpu serve",
+        description="run the supervised multi-tenant run daemon")
+    p.add_argument("--queue-dir", required=True, metavar="DIR",
+                   help="queue directory (created if absent); the "
+                        "daemon's whole durable state lives here")
+    p.add_argument("--http", type=int, default=None, metavar="PORT",
+                   help="also serve /healthz, /submit, /status/<id> on "
+                        "127.0.0.1:PORT (0 picks a free port)")
+    p.add_argument("--poll", type=float, default=0.2, metavar="S",
+                   help="queue/worker poll interval (default 0.2s)")
+    p.add_argument("--max-queue", type=int, default=64, metavar="N",
+                   help="refuse new requests past this backlog "
+                        "(default 64)")
+    p.add_argument("--max-workers", type=int, default=4, metavar="N",
+                   help="concurrent worker subprocesses (default 4); "
+                        "further admitted requests wait in the queue")
+    p.add_argument("--drain-grace", type=float, default=30.0,
+                   metavar="S",
+                   help="SIGTERM drain: seconds to wait for workers to "
+                        "checkpoint before SIGKILL (default 30)")
+    p.add_argument("--retry-attempts", type=int,
+                   default=DEFAULT_RETRY_ATTEMPTS, metavar="N",
+                   help="max attempts per request on device-side infra "
+                        "failure, exponential backoff between "
+                        "(default 3)")
+    p.add_argument("--no-batch", action="store_true",
+                   help="disable sweep auto-batching of compatible "
+                        "queued requests")
+    args = p.parse_args(argv)
+    sup = Supervisor(
+        args.queue_dir, poll_s=args.poll, max_queue=args.max_queue,
+        max_workers=args.max_workers, drain_grace_s=args.drain_grace,
+        retry_attempts=args.retry_attempts,
+        batching=not args.no_batch, http_port=args.http)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

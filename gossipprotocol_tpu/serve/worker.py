@@ -1,0 +1,79 @@
+"""Per-request worker: one subprocess per admitted run.
+
+``python -m gossipprotocol_tpu.serve.worker -- <cli argv...>`` runs the
+plain CLI in-process — a daemon-executed run is bitwise-identical to the
+same argv run standalone because it IS the same code path — after
+installing the graceful-drain machinery:
+
+* SIGTERM sets a flag the engine's host loop checks at every chunk
+  boundary (:func:`engine.driver.install_stop_check`); the run saves a
+  checkpoint (when configured) and exits with code 3 ("drained").
+* An accelerator-runtime death that escapes the CLI's own
+  ``--auto-resume`` chain exits with code 4 ("infra failure") so the
+  supervisor can retry with backoff instead of reading it as a crash.
+
+Exit codes the supervisor reads::
+
+    0  converged          1  ran its course, not converged
+    2  bad request/config 3  drained (checkpoint saved, resumable)
+    4  infra failure      5  worker crashed (bug — traceback in the log)
+
+Subprocess isolation is the point: a poisoned run (OOM, a wedged device
+call, a segfaulting extension) takes down this process, never the
+daemon, and SIGKILL is always available to the watchdog.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+EXIT_DRAINED = 3
+EXIT_INFRA = 4
+EXIT_CRASH = 5
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        print("usage: python -m gossipprotocol_tpu.serve.worker -- "
+              "<cli argv...>", file=sys.stderr)
+        return 2
+
+    stop = threading.Event()
+
+    def _sigterm(signum, frame):
+        # first TERM requests a drain; the engine reacts at the next
+        # chunk boundary. The supervisor escalates to SIGKILL itself if
+        # the grace window passes, so no re-raise logic lives here.
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
+    from gossipprotocol_tpu.engine import driver
+    from gossipprotocol_tpu import cli
+
+    driver.install_stop_check(stop.is_set)
+    try:
+        rc = cli.main(argv)
+    except SystemExit as e:  # argparse exits, re-exec paths
+        rc = e.code if isinstance(e.code, int) else 2
+    except BaseException as e:
+        if cli._is_runtime_death(e):
+            print(f"worker: accelerator runtime died ({type(e).__name__}: "
+                  f"{e})", file=sys.stderr)
+            return EXIT_INFRA
+        import traceback
+
+        traceback.print_exc()
+        return EXIT_CRASH
+    finally:
+        driver.install_stop_check(None)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -357,6 +357,24 @@ def latest(directory: str) -> str | None:
     return cands[0] if cands else None
 
 
+def latest_resumable(directory: str) -> Tuple[str, int] | None:
+    """``(path, round)`` of the newest *readable* checkpoint, or None.
+
+    Stronger than :func:`latest`: each candidate's metadata is actually
+    read, so a published-but-corrupt head entry falls through to the
+    next instead of being promised to a caller. The serve/ supervisor
+    uses this during crash recovery — a resume it announces in the
+    journal must be one the worker can deliver.
+    """
+    for path in candidates(directory):
+        try:
+            meta = peek_meta(path)
+            return path, int(meta.get("round", -1))
+        except Exception:
+            continue
+    return None
+
+
 def peek_meta(path: str) -> dict:
     """Metadata only, without materializing the state arrays.
 

@@ -1,0 +1,549 @@
+"""serve/ run-daemon tests: journal replay, the admission refusal
+matrix (pinned to exact messages), sweep auto-batch compatibility, the
+telemetry collision guard, plan-cache single-flight, the engine drain
+hook — and full daemon lifecycles as subprocesses: over-capacity
+refusal before any device work, round/wall budget enforcement, SIGKILL
+crash recovery (checkpointed run resumes bitwise, non-checkpointed
+stamped interrupted), SIGTERM drain, and auto-batching."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from gossipprotocol_tpu.serve import admission
+from gossipprotocol_tpu.serve import client
+from gossipprotocol_tpu.serve import journal as journal_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ~10s of CPU work at 20k rounds: slow enough to kill mid-flight,
+# deterministic enough to compare bitwise (push-sum's estimate_error).
+# Long runs are bounded with --max-rounds in argv rather than a request
+# round_budget: line push-sum carries an ANALYTIC round prediction, so
+# any budget below ~11M rounds would (correctly) be refused up front.
+SLOW_ARGV = ["2048", "line", "push-sum", "--predicate", "global",
+             "--chunk-rounds", "256", "--seed", "3"]
+
+
+# ---------------------------------------------------------------------
+# journal
+
+
+def test_journal_append_replay_queue_wait(tmp_path):
+    j = journal_mod.Journal(str(tmp_path / "q"))
+    j.append("accepted", "r1")
+    j.append("admitted", "r1")
+    j.append("started", "r1", pid=123)
+    j.append("finished", "r1", converged=True, rounds=25)
+    j.append("accepted", "r2")
+    j.append("refused", "r2", reason="nope")
+    j.close()
+    # a torn final line (daemon died mid-write) must be skipped
+    with open(j.paths.journal, "a") as fh:
+        fh.write('{"v": 1, "event": "started", "request_i')
+    states = journal_mod.replay(j.records())
+    assert set(states) == {"r1", "r2"}
+    assert states["r1"].phase == "finished" and states["r1"].terminal
+    assert states["r1"].verdict == "admitted"
+    assert states["r1"].queue_wait_s is not None
+    assert states["r2"].phase == "refused" and states["r2"].terminal
+    assert states["r2"].verdict == "refused"
+    assert states["r2"].last["reason"] == "nope"
+    # empty state (submitted, not yet seen by the daemon)
+    assert journal_mod.RequestState("rx").phase == "submitted"
+    assert not journal_mod.RequestState("rx").terminal
+
+
+# ---------------------------------------------------------------------
+# admission refusal matrix (messages are the API: pinned exactly)
+
+
+def test_admission_malformed_json():
+    with pytest.raises(admission.RequestError) as ei:
+        admission.parse_request_text("{nope")
+    assert str(ei.value).startswith("request invalid: not valid JSON")
+
+
+def test_admission_not_object():
+    with pytest.raises(admission.RequestError) as ei:
+        admission.normalize_request([1, 2])
+    assert str(ei.value) == admission.MSG_NOT_OBJECT
+
+
+def test_admission_bad_argv():
+    for bad in ({}, {"argv": []}, {"argv": "64 full"}, {"argv": [64]}):
+        with pytest.raises(admission.RequestError) as ei:
+            admission.normalize_request(bad)
+        assert str(ei.value) == admission.MSG_BAD_ARGV
+
+
+def test_admission_managed_flags_refused():
+    doc = {"argv": ["64", "full", "gossip", "--telemetry-dir=/x"]}
+    with pytest.raises(admission.RequestError) as ei:
+        admission.normalize_request(doc)
+    assert str(ei.value) == admission.MSG_MANAGED.format(
+        flag="--telemetry-dir")
+    doc = {"argv": ["64", "full", "gossip", "--round-budget", "5"]}
+    with pytest.raises(admission.RequestError) as ei:
+        admission.normalize_request(doc)
+    assert str(ei.value) == admission.MSG_MANAGED.format(
+        flag="--round-budget")
+
+
+def test_admission_bad_fields():
+    base = {"argv": ["64", "full", "gossip"]}
+    for field, want, vals in (
+        ("round_budget", "a positive integer", (0, -1, 1.5, "x", True)),
+        ("wall_budget_s", "a positive number", (0, -2, "x", True)),
+        ("checkpoint_every", "a positive integer", (0, "x", True)),
+    ):
+        for v in vals:
+            with pytest.raises(admission.RequestError) as ei:
+                admission.normalize_request({**base, field: v})
+            assert str(ei.value) == admission.MSG_BAD_FIELD.format(
+                field=field, want=want)
+
+
+def test_admission_argparse_error_becomes_refusal():
+    doc = admission.normalize_request(
+        {"argv": ["64", "full", "gossip", "--not-a-flag"]})
+    d = admission.evaluate(doc)
+    assert isinstance(d, admission.Refused)
+    assert d.reason.startswith("request invalid: ")
+    assert d.verdict_doc["verdict"] == "refused"
+
+
+def test_admission_capacity_refusal_matches_cli(monkeypatch, capsys):
+    """The 429-style capacity refusal IS the CLI preflight's message —
+    byte-identical, because it is the same CapacityError."""
+    monkeypatch.setenv("GOSSIP_TPU_HBM_BYTES", str(64 * 1024 * 1024))
+    argv = ["5000000", "line", "gossip"]
+    d = admission.evaluate(admission.normalize_request({"argv": argv}))
+    assert isinstance(d, admission.Refused)
+    assert "exceeds 90% of device capacity" in d.reason
+
+    from gossipprotocol_tpu.cli import main as cli_main
+
+    rc = cli_main(argv)
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert d.reason in err
+
+
+def test_admission_over_budget_analytic_refused():
+    doc = admission.normalize_request(
+        {"argv": ["256", "line", "push-sum", "--predicate", "global"],
+         "round_budget": 5})
+    d = admission.evaluate(doc)
+    assert isinstance(d, admission.Refused)
+    assert d.reason.startswith("over budget: predicted ")
+    assert "round_budget 5" in d.reason
+    assert "(spectral-pushsum, analytic)" in d.reason
+
+
+def test_admission_heuristic_prediction_admits():
+    # gossip's round model is heuristic-confidence: never refused on it
+    doc = admission.normalize_request(
+        {"argv": ["256", "line", "gossip"], "round_budget": 5})
+    d = admission.evaluate(doc)
+    assert isinstance(d, admission.Admitted)
+    assert d.verdict_doc["prediction"]["confidence"] == "heuristic"
+
+
+def test_batch_key_and_sweepable():
+    def admitted(argv, **fields):
+        doc = admission.normalize_request({"argv": argv, **fields})
+        d = admission.evaluate(doc)
+        assert isinstance(d, admission.Admitted), getattr(d, "reason", "")
+        return doc, d.args
+
+    a = admitted(["64", "full", "gossip", "--seed", "1"],
+                 round_budget=500)
+    b = admitted(["64", "full", "gossip", "--seed", "2"],
+                 round_budget=500)
+    c = admitted(["64", "full", "gossip", "--seed", "2"],
+                 round_budget=600)
+    assert admission.batch_key(*a) == admission.batch_key(*b)
+    assert admission.batch_key(*b) != admission.batch_key(*c)
+    assert admission.sweepable(*a)
+    # checkpointed requests never batch (lanes are not checkpointable)
+    d = admitted(["64", "full", "gossip"], checkpoint_every=2)
+    assert not admission.sweepable(*d)
+    e = admitted(["64", "full", "gossip", "--devices", "2"])
+    assert not admission.sweepable(*e)
+
+
+# ---------------------------------------------------------------------
+# telemetry dir collision guard
+
+
+def test_telemetry_collision_guard(tmp_path):
+    from gossipprotocol_tpu.obs.telemetry import (
+        Telemetry, TelemetryDirCollision,
+    )
+
+    d = tmp_path / "tel"
+    d.mkdir()
+    (d / "run.json").write_text(json.dumps(
+        {"kind": "run_manifest", "request_id": "req-other"}))
+    with pytest.raises(TelemetryDirCollision) as ei:
+        Telemetry(str(d), run_id="req-mine")
+    assert "already holds run.json from a different run" in str(ei.value)
+    assert "req-other" in str(ei.value) and "req-mine" in str(ei.value)
+    # same id: reuse is legitimate (a resumed request)
+    t = Telemetry(str(d), run_id="req-other")
+    assert t.dir == str(d)
+    # uniquify: sibling dir with a numeric suffix
+    t = Telemetry(str(d), run_id="req-mine", collision="uniquify")
+    assert t.dir == str(d) + "-2"
+    # anonymous runs keep the historical overwrite-on-reuse behavior
+    t = Telemetry(str(d))
+    assert t.dir == str(d)
+
+
+# ---------------------------------------------------------------------
+# plan-cache single-flight
+
+
+def test_plancache_single_flight(tmp_path):
+    import fcntl
+
+    from gossipprotocol_tpu import build_topology
+    from gossipprotocol_tpu.ops import plancache
+
+    topo = build_topology("er", 200, seed=5, avg_degree=3.0)
+    cache_dir = str(tmp_path / "plans")
+    rd, state = plancache.routed_delivery_cached(
+        topo, cache_dir=cache_dir, device=False)
+    assert state == "miss"
+    _, state = plancache.routed_delivery_cached(
+        topo, cache_dir=cache_dir, device=False)
+    assert state == "hit"
+
+    # contention: hold the entry's build lock, start a second builder,
+    # publish the entry while it waits — it must come back a "hit"
+    # (one build total), with the wait noted in its progress line
+    path = plancache.entry_path(cache_dir, plancache.cache_key(topo))
+    os.unlink(path)
+    lock_fh = open(path + ".lock", "a")
+    fcntl.flock(lock_fh, fcntl.LOCK_EX)
+    notes = []
+    result = {}
+
+    def contender():
+        result["rd"], result["state"] = plancache.routed_delivery_cached(
+            topo, cache_dir=cache_dir, device=False,
+            progress=notes.append)
+
+    t = threading.Thread(target=contender)
+    t.start()
+    time.sleep(0.5)             # let it block on the flock
+    assert t.is_alive()
+    plancache.save(rd, path)    # "the other builder" publishes
+    fcntl.flock(lock_fh, fcntl.LOCK_UN)
+    lock_fh.close()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert result["state"] == "hit"
+    assert any("single-flight wait" in n for n in notes)
+
+
+# ---------------------------------------------------------------------
+# engine drain hook (the worker's SIGTERM path, exercised in-process)
+
+
+def test_driver_drain_hook_checkpoints_and_exits_3(tmp_path, capsys):
+    from gossipprotocol_tpu.engine import driver
+    from gossipprotocol_tpu.cli import main as cli_main
+
+    ckpt = tmp_path / "ckpt"
+    tel = tmp_path / "tel"
+    driver.install_stop_check(lambda: True)
+    try:
+        rc = cli_main(["64", "full", "gossip", "--chunk-rounds", "8",
+                       "--checkpoint-dir", str(ckpt),
+                       "--checkpoint-every", "1",
+                       "--telemetry-dir", str(tel)])
+    finally:
+        driver.install_stop_check(None)
+    err = capsys.readouterr().err
+    assert rc == 3
+    assert "drained at round" in err
+    assert any(f.startswith("ckpt_round") for f in os.listdir(ckpt))
+    manifest = json.loads((tel / "run.json").read_text())
+    assert manifest["result"]["stopped"] == "drain"
+
+
+# ---------------------------------------------------------------------
+# daemon lifecycle (subprocess integration)
+
+
+def _start_daemon(queue_dir, *extra, env_extra=None):
+    env = os.environ.copy()
+    env.update(env_extra or {})
+    os.makedirs(str(queue_dir), exist_ok=True)
+    log = open(os.path.join(str(queue_dir), "daemon.log"), "a")
+    # own session: per-test killpg reaches the daemon AND its workers
+    # (the supervisor deliberately keeps workers in its process group)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gossipprotocol_tpu", "serve",
+         "--queue-dir", str(queue_dir), "--poll", "0.05",
+         "--drain-grace", "60", *extra],
+        cwd=REPO, stdout=log, stderr=subprocess.STDOUT, env=env,
+        start_new_session=True)
+    proc._log_fh = log
+    return proc
+
+
+def _stop_daemon(proc, timeout=90):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=timeout)
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+        proc._log_fh.close()
+    return rc
+
+
+def _phase(queue_dir, rid):
+    st = client.request_state(str(queue_dir), rid)
+    return st.phase if st is not None else "submitted"
+
+
+def _wait_phase(queue_dir, rid, phases, timeout=150):
+    deadline = time.monotonic() + timeout
+    p = None
+    while time.monotonic() < deadline:
+        p = _phase(queue_dir, rid)
+        if p in phases:
+            return p
+        time.sleep(0.1)
+    raise AssertionError(f"{rid} never reached {phases} (stuck: {p!r})")
+
+
+def _events(queue_dir, rid):
+    paths = journal_mod.QueuePaths(str(queue_dir))
+    states = journal_mod.replay(journal_mod.read_journal(paths.journal))
+    return states[rid].events
+
+
+def test_daemon_refuses_then_keeps_serving(tmp_path):
+    """Over-capacity refusal happens before any device work, with the
+    CLI preflight's message; the daemon then serves the next request
+    and drains clean on SIGTERM (exit 0)."""
+    q = tmp_path / "q"
+    proc = _start_daemon(
+        q, env_extra={"GOSSIP_TPU_HBM_BYTES": str(64 * 1024 * 1024)})
+    try:
+        big = client.submit(str(q), {"argv": ["5000000", "line", "gossip"]})
+        assert _wait_phase(q, big, {"refused"}) == "refused"
+        ev = _events(q, big)
+        assert ev[-1]["event"] == "refused"
+        assert "exceeds 90% of device capacity" in ev[-1]["reason"]
+        # refused strictly before device work: no worker, no telemetry
+        assert not any(e["event"] == "started" for e in ev)
+        paths = journal_mod.QueuePaths(str(q))
+        assert not os.path.exists(paths.telemetry_dir(big))
+
+        ok = client.submit(str(q), {"argv": ["64", "full", "gossip",
+                                             "--seed", "7"],
+                                    "round_budget": 500})
+        assert _wait_phase(q, ok, {"finished"}) == "finished"
+        last = _events(q, ok)[-1]
+        assert last["converged"] is True
+        # the admission verdict is on disk next to the run
+        verdict = json.loads(
+            open(paths.admission_file(ok)).read())
+        assert verdict["verdict"] == "admitted"
+        # ... and stamped into the run manifest
+        manifest = json.loads(open(os.path.join(
+            paths.telemetry_dir(ok), "run.json")).read())
+        assert manifest["request_id"] == ok
+        assert manifest["admission"]["verdict"] == "admitted"
+    finally:
+        rc = _stop_daemon(proc)
+    assert rc == 0
+
+
+def test_daemon_budget_blowouts_do_not_kill_daemon(tmp_path):
+    """A round-budget blowout is stamped over_budget, a wall-budget hang
+    is killed and stamped timeout — and the daemon serves the next
+    request after both."""
+    q = tmp_path / "q"
+    proc = _start_daemon(q)
+    try:
+        # gossip's prediction is heuristic-confidence, so this budget is
+        # admitted — and a 2048-node line cannot spread a rumor end to
+        # end in 2000 rounds, so the driver's budget stop is guaranteed
+        over = client.submit(
+            str(q), {"argv": ["2048", "line", "gossip", "--seed", "3",
+                              "--chunk-rounds", "256"],
+                     "round_budget": 2000})
+        assert _wait_phase(q, over, {"over_budget"}) == "over_budget"
+        last = _events(q, over)[-1]
+        assert last["rounds"] == 2000  # stopped exactly at the budget
+        hung = client.submit(str(q),
+                             {"argv": SLOW_ARGV + ["--max-rounds",
+                                                   "500000"],
+                              "wall_budget_s": 3})
+        assert _wait_phase(q, hung, {"timeout"}) == "timeout"
+        assert "wall budget" in _events(q, hung)[-1]["reason"]
+
+        ok = client.submit(str(q), {"argv": ["64", "full", "gossip"]})
+        assert _wait_phase(q, ok, {"finished"}) == "finished"
+    finally:
+        rc = _stop_daemon(proc)
+    assert rc == 0
+
+
+def test_daemon_sigkill_recovery(tmp_path):
+    """SIGKILL the daemon (and its workers) mid-run; restart. The
+    checkpointed run resumes and lands bitwise-identical to the same
+    config run standalone; the non-checkpointed one is stamped
+    interrupted."""
+    q = tmp_path / "q"
+    paths = journal_mod.QueuePaths(str(q))
+    proc = _start_daemon(q)
+    ckpt_req = client.submit(
+        str(q), {"argv": SLOW_ARGV + ["--max-rounds", "20000"],
+                 "checkpoint_every": 2})
+    raw_req = client.submit(
+        str(q), {"argv": SLOW_ARGV + ["--max-rounds", "500000"]})
+    try:
+        _wait_phase(q, ckpt_req, {"started"})
+        _wait_phase(q, raw_req, {"started"})
+        ckpt_dir = paths.checkpoint_dir(ckpt_req)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.isdir(ckpt_dir) and any(
+                    f.startswith("ckpt_round") and f.endswith(".npz")
+                    and ".tmp" not in f
+                    for f in os.listdir(ckpt_dir)):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("no checkpoint landed before kill")
+    finally:
+        os.killpg(proc.pid, signal.SIGKILL)  # machine crash, in effect
+        proc.wait()
+        proc._log_fh.close()
+
+    proc = _start_daemon(q)
+    try:
+        assert _wait_phase(q, ckpt_req, {"finished"}) == "finished"
+        ev = _events(q, ckpt_req)
+        assert ev[-1]["converged"] is False  # line at 20k rounds: no
+        assert ev[-1]["rounds"] == 20000
+        rec = [e for e in ev if e["event"] == "recovered"]
+        assert rec and "checkpoint at round" in rec[0]["resume"]
+        assert _wait_phase(q, raw_req, {"interrupted"}) == "interrupted"
+        assert "no checkpoint" in _events(q, raw_req)[-1]["reason"]
+    finally:
+        rc = _stop_daemon(proc)
+    assert rc == 0
+
+    # bitwise: the recovered daemon run == the same config standalone
+    tel = tmp_path / "standalone"
+    r = subprocess.run(
+        [sys.executable, "-m", "gossipprotocol_tpu", *SLOW_ARGV,
+         "--max-rounds", "20000", "--telemetry-dir", str(tel)],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 1, r.stderr  # hit max rounds, not converged
+    standalone = json.loads((tel / "run.json").read_text())
+    daemon_run = json.loads(open(os.path.join(
+        paths.telemetry_dir(ckpt_req), "run.json")).read())
+    assert (daemon_run["result"]["rounds"]
+            == standalone["result"]["rounds"])
+    assert (daemon_run["result"]["estimate_error"]
+            == standalone["result"]["estimate_error"])
+
+
+def test_daemon_sigterm_drains_inflight_run(tmp_path):
+    """SIGTERM with a run in flight: the worker checkpoints at the next
+    chunk boundary, the request is journaled drained, the daemon exits
+    0."""
+    q = tmp_path / "q"
+    paths = journal_mod.QueuePaths(str(q))
+    proc = _start_daemon(q)
+    rid = client.submit(
+        str(q), {"argv": SLOW_ARGV + ["--max-rounds", "500000"],
+                 "checkpoint_every": 50})
+    try:
+        _wait_phase(q, rid, {"started"})
+        # let it get past compile into the round loop
+        tel_events = os.path.join(paths.telemetry_dir(rid),
+                                  "events.jsonl")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.exists(tel_events):
+                break
+            time.sleep(0.1)
+        time.sleep(1.0)
+    finally:
+        rc = _stop_daemon(proc)
+    assert rc == 0
+    assert _phase(q, rid) == "drained"
+    assert _events(q, rid)[-1]["checkpointed"] is True
+    ckpt_dir = paths.checkpoint_dir(rid)
+    assert any(f.startswith("ckpt_round")
+               for f in os.listdir(ckpt_dir))
+
+
+def test_daemon_auto_batches_compatible_requests(tmp_path):
+    """Two queued requests differing only in seed fuse into one sweep
+    program; each gets its own lane outcome under its own id."""
+    q = tmp_path / "q"
+    a = client.submit(str(q), {"argv": ["64", "full", "gossip",
+                                        "--seed", "11"],
+                               "round_budget": 500})
+    b = client.submit(str(q), {"argv": ["64", "full", "gossip",
+                                        "--seed", "12"],
+                               "round_budget": 500})
+    proc = _start_daemon(q)
+    try:
+        assert _wait_phase(q, a, {"finished"}) == "finished"
+        assert _wait_phase(q, b, {"finished"}) == "finished"
+        ev_a, ev_b = _events(q, a), _events(q, b)
+        ba = [e for e in ev_a if e["event"] == "batched"]
+        bb = [e for e in ev_b if e["event"] == "batched"]
+        assert ba and bb and ba[0]["batch"] == bb[0]["batch"]
+        assert {ba[0]["lane"], bb[0]["lane"]} == {0, 1}
+        assert ev_a[-1]["converged"] is True
+        assert ev_b[-1]["converged"] is True
+    finally:
+        rc = _stop_daemon(proc)
+    assert rc == 0
+
+
+def test_history_indexes_daemon_requests(tmp_path):
+    from gossipprotocol_tpu.obs import history
+
+    j = journal_mod.Journal(str(tmp_path / "q"))
+    j.append("accepted", "r1")
+    j.append("admitted", "r1")
+    j.append("started", "r1", pid=1)
+    j.append("finished", "r1", converged=True, rounds=12)
+    j.append("accepted", "r2")
+    j.append("refused", "r2", reason="queue full: 9 requests pending")
+    j.close()
+    recs = history.build_index(str(tmp_path), write=False)
+    reqs = {r["request_id"]: r for r in recs if r["kind"] == "request"}
+    assert reqs["r1"]["phase"] == "finished"
+    assert reqs["r1"]["verdict"] == "admitted"
+    assert reqs["r1"]["queue_wait_s"] is not None
+    assert reqs["r2"]["verdict"] == "refused"
+    assert "queue full" in reqs["r2"]["reason"]
+    import io
+
+    out = io.StringIO()
+    history.render_history(recs, out)
+    assert "indexed daemon requests (2):" in out.getvalue()
