@@ -21,6 +21,22 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def _infra_stamp(attempts: int, outcome: str) -> dict:
+    """Infra-retry trail under the SAME metric names the serve daemon's
+    /metrics exporter uses (gossip_infra_retries_total,
+    gossip_retry_backoff_seconds_total), so ``history`` can join bench
+    infra-failures with daemon retry totals without a rename table.
+    ``attempts`` is total probe attempts (retries = attempts - 1);
+    backoff mirrors the probe's 2**(k-1) sleeps between attempts."""
+    retries = max(0, attempts - 1)
+    return {
+        "gossip_infra_retries_total": retries,
+        "gossip_retry_backoff_seconds_total": round(
+            sum(2.0 ** (k - 1) for k in range(1, attempts)), 2),
+        "infra_outcome": outcome,
+    }
+
+
 def _probe_backend() -> int:
     """Fast-fail when the accelerator worker is dead or unreachable.
 
@@ -81,6 +97,7 @@ def _probe_backend() -> int:
         "detail": detail,
         "peak_rss_bytes": host_peak_rss_bytes(),
         "requested_backend": os.environ.get("JAX_PLATFORMS", "auto"),
+        **_infra_stamp(max_attempts, "infra_failure"),
     }), flush=True)
     sys.exit(3)
 
@@ -725,6 +742,7 @@ def main():
         # flags a flaky worker even when the benchmark itself succeeded
         "infra_failure": False,
         "probe_attempts": probe_attempts,
+        **_infra_stamp(probe_attempts, "ok"),
         **aux_vec,
     }
     # backup record on stderr BEFORE the 10M attempt: a process-fatal 10M

@@ -31,8 +31,11 @@ replayable across resume),
 the ``report`` subcommand: ``python -m gossipprotocol_tpu report DIR``),
 ``--round-budget``/``--trace-cap`` (convergence observatory: analytic
 round budgets and per-round trace downsampling; live-tail a running dir
-with ``watch DIR``, diff runs with ``report DIR --compare BASELINE``,
-track bench history with ``history``),
+with ``watch DIR``, a serve daemon's whole queue with ``watch
+--queue-dir D`` — queue depth, per-worker progress, SLO burn rates —
+diff runs with ``report DIR --compare BASELINE``,
+track bench history with ``history``; a daemon started with ``--http``
+also serves Prometheus text exposition at ``/metrics``),
 ``--sweep``/``--sweep-seeds`` (mega-sweeps: B lanes of traced-value
 variations — seeds, tolerances, activation rates, drop probabilities —
 batched through ONE compiled chunk program under vmap; lane *i* is

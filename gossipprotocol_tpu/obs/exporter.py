@@ -1,0 +1,437 @@
+"""Zero-dependency Prometheus exposition for the serve daemon.
+
+:class:`Registry` is a minimal metrics registry — counters, gauges, and
+fixed-bucket histograms — that renders the Prometheus text exposition
+format (version 0.0.4) with nothing but the stdlib, served at
+``/metrics`` on the daemon's loopback HTTP surface.
+
+:class:`FleetMetrics` is the daemon-side fold: every journal record the
+supervisor appends is also :meth:`~FleetMetrics.observe`-d into the
+registry, and on restart the registry is rebuilt by folding the whole
+``journal.jsonl`` through the *same* code path
+(:meth:`FleetMetrics.from_records`). Because every monotonic counter is
+a pure function of the journal — and the journal survives SIGKILL by
+construction — counter values are bitwise-preserved across a daemon
+crash: the restarted daemon's ``/metrics`` renders the same totals the
+dead one did.
+
+Gauges (queue depth, worker slots) are live supervisor state, set just
+before each render; they are deliberately NOT journal-derived.
+
+:func:`parse_text_exposition` is the strict zero-dep parser the tests
+(and any scraper without a Prometheus client library) use: every line
+must be a well-formed HELP/TYPE/sample line of a declared family, or it
+raises.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from gossipprotocol_tpu.serve.journal import TERMINAL_EVENTS
+
+# fixed histogram buckets (seconds). Queue wait is dominated by worker
+# slots freeing up (sub-second to minutes); run wall by compile + the
+# round loop (seconds to an hour).
+WAIT_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                30.0, 60.0, 120.0, 300.0)
+RUN_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+               300.0, 600.0, 1800.0, 3600.0)
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"        # metric name
+    r"(?:\{([^}]*)\})?"                    # optional {labels}
+    r" (-?(?:[0-9.eE+-]+|\+Inf|-Inf|NaN))$")  # value
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _fmt_value(v: float) -> str:
+    if isinstance(v, float) and math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v) == int(v):
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_label_value(v: str) -> str:
+    return (v.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_labels(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{_fmt_label_value(str(v))}"'
+                     for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter; with ``labels``, one series per value tuple."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str,
+                 labels: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labels = tuple(labels)
+        self.values: Dict[Tuple[str, ...], float] = {}
+        if not self.labels:
+            self.values[()] = 0.0
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(str(labels[n]) for n in self.labels)
+        self.values[key] = self.values.get(key, 0.0) + amount
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for key in sorted(self.values):
+            lines.append(f"{self.name}{_fmt_labels(self.labels, key)} "
+                         f"{_fmt_value(self.values[key])}")
+        return lines
+
+
+class Gauge(Counter):
+    """Settable instantaneous value (live state, not journal-derived)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(str(labels[n]) for n in self.labels)
+        self.values[key] = float(value)
+
+
+class Histogram:
+    """Fixed-bucket histogram: cumulative ``_bucket`` series + ``_sum``
+    and ``_count``, the classic Prometheus layout."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, buckets: Sequence[float]):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * len(self.buckets)  # per-bucket, NOT cumulative
+        self.inf_count = 0
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.sum += value
+        self.count += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.inf_count += 1
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        cum = 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            lines.append(
+                f'{self.name}_bucket{{le="{_fmt_value(b)}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {self.count}')
+        lines.append(f"{self.name}_sum {_fmt_value(round(self.sum, 6))}")
+        lines.append(f"{self.name}_count {self.count}")
+        return lines
+
+
+class Registry:
+    """Ordered family registry; :meth:`render` is the /metrics body."""
+
+    def __init__(self):
+        self.families: Dict[str, Any] = {}
+
+    def _add(self, fam):
+        if not _NAME_RE.match(fam.name):
+            raise ValueError(f"bad metric name {fam.name!r}")
+        if fam.name in self.families:
+            raise ValueError(f"duplicate metric {fam.name!r}")
+        self.families[fam.name] = fam
+        return fam
+
+    def counter(self, name: str, help: str,
+                labels: Sequence[str] = ()) -> Counter:
+        return self._add(Counter(name, help, labels))
+
+    def gauge(self, name: str, help: str,
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._add(Gauge(name, help, labels))
+
+    def histogram(self, name: str, help: str,
+                  buckets: Sequence[float]) -> Histogram:
+        return self._add(Histogram(name, help, buckets))
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for fam in self.families.values():
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------
+# the strict parser (tests + client-side scraping without a library)
+
+
+def parse_text_exposition(text: str) -> Dict[str, Dict[str, Any]]:
+    """Parse Prometheus text exposition into
+    ``{family: {type, help, samples: [(name, labels, value)]}}``.
+
+    Strict by design: any line that is not a well-formed HELP/TYPE line
+    or a sample of an already-declared family raises ``ValueError`` with
+    the offending line — the golden tests feed every rendered line
+    through here.
+    """
+    families: Dict[str, Dict[str, Any]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            raise ValueError(f"line {lineno}: blank line in exposition")
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            if not _NAME_RE.match(name):
+                raise ValueError(f"line {lineno}: bad HELP name {name!r}")
+            families.setdefault(
+                name, {"type": None, "help": None, "samples": []}
+            )["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, kind = rest.partition(" ")
+            if kind not in ("counter", "gauge", "histogram", "summary",
+                            "untyped"):
+                raise ValueError(f"line {lineno}: bad TYPE {kind!r}")
+            families.setdefault(
+                name, {"type": None, "help": None, "samples": []}
+            )["type"] = kind
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"line {lineno}: unexpected comment {line!r}")
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: unparseable sample {line!r}")
+        name, label_text, value_text = m.groups()
+        fam_name = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and families.get(base, {}).get("type") == "histogram":
+                fam_name = base
+                break
+        if fam_name not in families:
+            raise ValueError(
+                f"line {lineno}: sample {name!r} has no declared family")
+        labels: Dict[str, str] = {}
+        if label_text:
+            pos = 0
+            while pos < len(label_text):
+                lm = _LABEL_RE.match(label_text, pos)
+                if lm is None:
+                    raise ValueError(
+                        f"line {lineno}: unparseable labels "
+                        f"{label_text!r} at offset {pos}")
+                labels[lm.group(1)] = (
+                    lm.group(2).replace('\\"', '"')
+                    .replace("\\n", "\n").replace("\\\\", "\\"))
+                pos = lm.end()
+                if pos < len(label_text):
+                    if label_text[pos] != ",":
+                        raise ValueError(
+                            f"line {lineno}: expected ',' in labels "
+                            f"{label_text!r} at offset {pos}")
+                    pos += 1
+        if value_text == "+Inf":
+            value = math.inf
+        elif value_text == "-Inf":
+            value = -math.inf
+        else:
+            value = float(value_text)
+        families[fam_name]["samples"].append((name, labels, value))
+    for name, fam in families.items():
+        if fam["type"] is None:
+            raise ValueError(f"family {name!r} has samples but no TYPE")
+    return families
+
+
+def check_histogram_consistency(name: str,
+                                fam: Dict[str, Any]) -> None:
+    """Raise unless the histogram family is internally consistent:
+    cumulative bucket counts are non-decreasing in ``le``, the ``+Inf``
+    bucket equals ``_count``, and every bound parses and is ordered."""
+    buckets = [(labels.get("le"), v) for n, labels, v in fam["samples"]
+               if n == name + "_bucket"]
+    count = next(v for n, _, v in fam["samples"] if n == name + "_count")
+    if not buckets:
+        raise ValueError(f"{name}: no _bucket samples")
+    bounds = []
+    prev = None
+    for le, v in buckets:
+        b = math.inf if le == "+Inf" else float(le)
+        bounds.append(b)
+        if prev is not None and v < prev:
+            raise ValueError(f"{name}: bucket counts decrease at le={le}")
+        prev = v
+    if bounds != sorted(bounds):
+        raise ValueError(f"{name}: bucket bounds out of order")
+    if not math.isinf(bounds[-1]):
+        raise ValueError(f"{name}: missing +Inf bucket")
+    if buckets[-1][1] != count:
+        raise ValueError(
+            f"{name}: +Inf bucket {buckets[-1][1]} != _count {count}")
+
+
+# ---------------------------------------------------------------------
+# the daemon fold: journal records -> registry
+
+
+def refusal_reason_class(reason: str) -> str:
+    """Collapse a refusal message into a bounded label set (labels must
+    not carry unbounded cardinality like raw message text)."""
+    reason = reason or ""
+    if reason.startswith("queue full"):
+        return "queue_full"
+    if reason.startswith("over budget"):
+        return "over_budget"
+    if "exceeds 90% of device capacity" in reason:
+        return "capacity"
+    if (reason.startswith("request invalid")
+            or reason.startswith("request unreadable")):
+        return "invalid"
+    return "other"
+
+
+class FleetMetrics:
+    """The serve daemon's metric fold over journal records.
+
+    ``observe`` is called once per appended journal record (live) and
+    once per replayed record (restart): identical record streams produce
+    identical — bitwise — counter and histogram states, which is the
+    whole SIGKILL-durability story.
+    """
+
+    def __init__(self):
+        r = self.registry = Registry()
+        self.accepted = r.counter(
+            "gossip_requests_accepted_total",
+            "Requests moved from incoming/ into the daemon's queue.")
+        self.admitted = r.counter(
+            "gossip_requests_admitted_total",
+            "Requests that passed admission (capacity + budget).")
+        self.refused = r.counter(
+            "gossip_requests_refused_total",
+            "Requests refused at admission, by reason class.",
+            labels=("reason",))
+        self.outcomes = r.counter(
+            "gossip_requests_outcome_total",
+            "Terminal request outcomes (plus drained pauses).",
+            labels=("outcome",))
+        self.retries = r.counter(
+            "gossip_infra_retries_total",
+            "Device-side infra failures re-queued with backoff.")
+        self.backoff_s = r.counter(
+            "gossip_retry_backoff_seconds_total",
+            "Total backoff seconds scheduled before infra retries.")
+        self.sweep_batches = r.counter(
+            "gossip_sweep_batches_total",
+            "Sweep batches fused from compatible queued requests.")
+        self.sweep_lanes = r.counter(
+            "gossip_sweep_batch_lanes_total",
+            "Requests executed as sweep lanes inside a batch.")
+        self.queue_depth = r.gauge(
+            "gossip_queue_depth",
+            "Requests pending or running right now (live state).")
+        self.workers_active = r.gauge(
+            "gossip_workers_active",
+            "Worker subprocesses currently running (live state).")
+        self.workers_max = r.gauge(
+            "gossip_workers_max",
+            "Configured worker-slot ceiling (--max-workers).")
+        self.queue_max = r.gauge(
+            "gossip_queue_max",
+            "Configured backlog ceiling (--max-queue).")
+        self.wait_hist = r.histogram(
+            "gossip_request_queue_wait_seconds",
+            "Seconds from acceptance to first worker start (or refusal).",
+            WAIT_BUCKETS)
+        self.run_hist = r.histogram(
+            "gossip_request_run_wall_seconds",
+            "Seconds from first worker start to the terminal event.",
+            RUN_BUCKETS)
+        self._accepted_ts: Dict[str, float] = {}
+        self._started_ts: Dict[str, float] = {}
+        self._waited: set = set()
+        self._batch_ids: set = set()
+
+    @classmethod
+    def from_records(cls, records: List[Dict[str, Any]]) -> "FleetMetrics":
+        """Rebuild the registry by folding a replayed journal — the
+        restart path. Same fold as live, so same bytes."""
+        m = cls()
+        for rec in records:
+            m.observe(rec)
+        return m
+
+    def observe(self, rec: Dict[str, Any]) -> None:
+        event = rec.get("event")
+        rid = rec.get("request_id")
+        ts = rec.get("ts")
+        if event == "accepted":
+            self.accepted.inc()
+            if isinstance(ts, (int, float)):
+                self._accepted_ts[rid] = ts
+        elif event == "admitted":
+            self.admitted.inc()
+        elif event == "refused":
+            self.refused.inc(
+                reason=refusal_reason_class(rec.get("reason", "")))
+            self._observe_wait(rid, ts)
+        elif event in ("started", "batched"):
+            if event == "batched":
+                self.sweep_lanes.inc()
+                batch = rec.get("batch")
+                if batch and batch not in self._batch_ids:
+                    self._batch_ids.add(batch)
+                    self.sweep_batches.inc()
+            if rid not in self._started_ts and isinstance(
+                    ts, (int, float)):
+                self._started_ts[rid] = ts
+            self._observe_wait(rid, ts)
+        elif event == "retry":
+            self.retries.inc()
+            backoff = rec.get("backoff_s")
+            if isinstance(backoff, (int, float)):
+                self.backoff_s.inc(backoff)
+        elif event == "drained":
+            self.outcomes.inc(outcome="drained")
+        elif event in TERMINAL_EVENTS and event != "refused":
+            self.outcomes.inc(outcome=event)
+            started = self._started_ts.pop(rid, None)
+            if started is not None and isinstance(ts, (int, float)):
+                self.run_hist.observe(round(max(0.0, ts - started), 3))
+
+    def _observe_wait(self, rid: str, ts: Any) -> None:
+        if rid in self._waited:
+            return
+        accepted = self._accepted_ts.get(rid)
+        if accepted is None or not isinstance(ts, (int, float)):
+            return
+        self._waited.add(rid)
+        self.wait_hist.observe(round(max(0.0, ts - accepted), 3))
+
+    def set_live(self, *, queue_depth: int, workers_active: int,
+                 workers_max: int, queue_max: int) -> None:
+        self.queue_depth.set(queue_depth)
+        self.workers_active.set(workers_active)
+        self.workers_max.set(workers_max)
+        self.queue_max.set(queue_max)
+
+    def render(self) -> str:
+        return self.registry.render()

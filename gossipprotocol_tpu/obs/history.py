@@ -64,6 +64,13 @@ def _bench_records(root: str) -> List[Dict[str, Any]]:
             rec["phase_s"] = parsed["phase_s"]
         if parsed.get("prediction_ratio") is not None:
             rec["prediction_ratio"] = parsed["prediction_ratio"]
+        # infra-outcome stamp (same metric names the /metrics exporter
+        # uses, so bench infra-failures join daemon retry totals)
+        for key in ("infra_failure", "probe_attempts", "infra_outcome",
+                    "gossip_infra_retries_total",
+                    "gossip_retry_backoff_seconds_total"):
+            if parsed.get(key) is not None:
+                rec[key] = parsed[key]
         out.append(rec)
     return out
 
@@ -77,9 +84,12 @@ def _manifest_records(root: str) -> List[Dict[str, Any]]:
     seen = set()
     for pat in pats:
         for path in sorted(glob.glob(pat)):
-            if path in seen:
+            # realpath: a symlinked artifacts dir must not index the
+            # same manifest twice under two spellings
+            real = os.path.realpath(path)
+            if real in seen:
                 continue
-            seen.add(path)
+            seen.add(real)
             doc = _load_json(path)
             if doc is None or doc.get("kind") != "run_manifest":
                 continue
@@ -100,6 +110,7 @@ def _manifest_records(root: str) -> List[Dict[str, Any]]:
                 "wall_ms": result.get("wall_ms"),
                 "predicted_rounds": pred.get("predicted_rounds"),
                 "actual_over_predicted": pred.get("actual_over_predicted"),
+                "request_id": doc.get("request_id"),
             }
             rec.update(_resource_metrics(os.path.dirname(path)))
             out.append(rec)
@@ -213,13 +224,43 @@ def _journal_records(root: str) -> List[Dict[str, Any]]:
     return out
 
 
+def _index_key(rec: Dict[str, Any], root: str) -> tuple:
+    """Identity of an index record: kind + the *resolved* source path +
+    the in-file id (request/lane). Two glob spellings of one artifact —
+    symlinked dirs, a queue dir that is both ROOT and under artifacts/ —
+    collapse to one key, so re-indexing never multiplies rows."""
+    kind = rec.get("kind")
+    src = os.path.realpath(os.path.join(root, rec.get("source") or ""))
+    if kind == "bench":
+        return (kind, rec.get("seq"), rec.get("metric"))
+    if kind == "request":
+        return (kind, src, rec.get("request_id"))
+    if kind == "sweep_lane":
+        return (kind, src, rec.get("lane"))
+    return (kind, src, rec.get("request_id"))
+
+
+def _dedupe(records: List[Dict[str, Any]],
+            root: str) -> List[Dict[str, Any]]:
+    seen = set()
+    out: List[Dict[str, Any]] = []
+    for rec in records:
+        key = _index_key(rec, root)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(rec)
+    return out
+
+
 def build_index(root: str, write: bool = True) -> List[Dict[str, Any]]:
     """Sweep ROOT for bench records, manifests, and daemon journals;
     optionally (re)write ``artifacts/run_index.jsonl`` (atomic
     tmp+rename — the index is a derived artifact, rebuilt whole each
-    time)."""
-    records = (_bench_records(root) + _manifest_records(root)
-               + _journal_records(root))
+    time). Records are deduped on (kind, resolved source, id) so
+    overlapping sweep patterns and symlinked dirs index once."""
+    records = _dedupe(_bench_records(root) + _manifest_records(root)
+                      + _journal_records(root), root)
     if write and records:
         path = os.path.join(root, INDEX_RELPATH)
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -264,6 +305,12 @@ def render_history(records: List[Dict[str, Any]], out: TextIO,
             line += _fmt_delta(val, (prev or {}).get("value"))
             if r.get("prediction_ratio") is not None:
                 line += f"  pred-ratio {r['prediction_ratio']:.2f}"
+            if r.get("gossip_infra_retries_total"):
+                line += (f"  infra-retries "
+                         f"{r['gossip_infra_retries_total']}")
+            if r.get("infra_failure") or (
+                    r.get("infra_outcome") == "infra_failure"):
+                line += "  INFRA-FAILURE"
             out.write(line + "\n")
             prev = r
         out.write("\n")

@@ -250,6 +250,18 @@ def render(data: Dict[str, Any], out: TextIO) -> None:
         if do:
             out.write(f"daemon outcome: {do.get('event')} — "
                       f"{do.get('reason')}\n")
+        for lc in manifest.get("lifecycle") or []:
+            # daemon-side request timeline (serve/lifecycle.py stamp):
+            # phase durations accepted->admitted->... -> outcome
+            steps = " -> ".join(
+                f"{p.get('phase')} {p.get('dur_s', 0.0):.2f}s"
+                for p in lc.get("phases") or [])
+            line = f"lifecycle: {lc.get('request_id')}  {steps}"
+            line += f" -> {lc.get('outcome')}"
+            if lc.get("retries"):
+                line += f"  ({lc['retries']} infra retr"
+                line += "y)" if lc["retries"] == 1 else "ies)"
+            out.write(line + "\n")
         if manifest.get("resume"):
             r = manifest["resume"]
             out.write(f"resumed: from {r.get('from')} at round {r.get('round')}\n")

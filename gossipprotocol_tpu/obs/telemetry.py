@@ -38,6 +38,30 @@ from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
 
 COUNTER_TOTAL_FIELDS = ("sent", "delivered", "dropped")
 
+# Chrome-trace process ids: the run's own host spans live on pid 1; the
+# serve daemon's request-lifecycle spans (serve/lifecycle.py) merge into
+# the same trace.json on pid 2, so one Perfetto view shows the daemon
+# timeline above the run's phases
+TRACE_PID_RUN = 1
+TRACE_PID_DAEMON = 2
+
+
+def write_trace_doc(path: str, events: List[Dict[str, Any]]) -> str:
+    """Atomically write a Perfetto-loadable Chrome-trace document. The
+    one trace.json writer — Telemetry and serve/lifecycle.py both go
+    through here so the envelope never drifts."""
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "gossipprotocol_tpu.obs",
+                      "v": SCHEMA_VERSION},
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+    return path
+
 
 class TelemetryDirCollision(ValueError):
     """The target dir already holds another run's ``run.json``.
@@ -362,7 +386,7 @@ class Telemetry:
             ev: Dict[str, Any] = {
                 "name": rec["name"],
                 "cat": "host",
-                "pid": 1,
+                "pid": TRACE_PID_RUN,
                 "tid": 1 + rec.get("depth", 0),
                 "ts": round(rec["start_s"] * 1e6, 3),
             }
@@ -375,16 +399,7 @@ class Telemetry:
             if rec.get("attrs"):
                 ev["args"] = rec["attrs"]
             events.append(ev)
-        doc = {
-            "traceEvents": events,
-            "displayTimeUnit": "ms",
-            "otherData": {"source": "gossipprotocol_tpu.obs", "v": SCHEMA_VERSION},
-        }
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(doc, fh)
-        os.replace(tmp, path)
-        return path
+        return write_trace_doc(path, events)
 
     def close(self) -> None:
         """Write ``trace.json`` and close ``events.jsonl``; idempotent."""

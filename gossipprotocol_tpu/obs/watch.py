@@ -11,6 +11,13 @@ separator-delimited frames instead (so CI logs stay readable).
 Exits 0 as soon as the manifest reports a result (the run finished) or
 after ``--max-frames`` refreshes; exits 2 when DIR is not a directory.
 A dir that has no telemetry *yet* is not an error — watch waits for it.
+
+``watch --queue-dir D`` is the fleet mode: instead of one run's
+telemetry it tails a serve daemon's queue dir — queue depth, what each
+worker is currently executing (request id + last published round), the
+SLO burn rates from :mod:`gossipprotocol_tpu.obs.slo`, and the
+daemon-level anomaly rules. The fleet frame never "finishes" (a daemon
+is long-lived); it exits only via ``--max-frames`` or ^C.
 """
 
 from __future__ import annotations
@@ -20,7 +27,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, TextIO
 
-from gossipprotocol_tpu.obs.anomaly import anomaly_flags
+from gossipprotocol_tpu.obs.anomaly import anomaly_flags, daemon_flags
 from gossipprotocol_tpu.obs.report import (
     ReportError,
     _metric_recs,
@@ -29,6 +36,9 @@ from gossipprotocol_tpu.obs.report import (
 )
 
 INTERVAL_DEFAULT = 2.0
+
+# journal phases that mean "a worker is executing this request now"
+_RUNNING_PHASES = ("started", "batched")
 
 
 def _frame(data: Dict[str, Any], out: TextIO) -> bool:
@@ -131,30 +141,102 @@ def _frame(data: Dict[str, Any], out: TextIO) -> bool:
     return result is not None
 
 
+def _fleet_frame(paths, out: TextIO) -> None:
+    """One frame of the fleet view over a serve queue dir."""
+    from gossipprotocol_tpu.obs import slo as slo_mod
+    from gossipprotocol_tpu.serve import journal as journal_mod
+    from gossipprotocol_tpu.serve import lifecycle as lifecycle_mod
+
+    states = journal_mod.replay(journal_mod.read_journal(paths.journal))
+    running = [st for st in states.values()
+               if st.phase in _RUNNING_PHASES]
+    pending = [st for st in states.values()
+               if not st.terminal and st.phase not in _RUNNING_PHASES]
+    try:
+        incoming = len([f for f in os.listdir(paths.incoming)
+                        if f.endswith(".json")])
+    except OSError:
+        incoming = 0
+    out.write(
+        f"queue depth {len(running) + len(pending) + incoming}"
+        f" ({len(running)} running, {len(pending)} pending"
+        + (f", {incoming} incoming" if incoming else "")
+        + ")\n")
+    for st in sorted(running, key=lambda s: s.id):
+        prog = lifecycle_mod.request_progress(paths, st) or {}
+        rnd = prog.get("round")
+        phase = prog.get("phase")
+        out.write(
+            f"worker  {st.id}"
+            + (f"  round {rnd}" if rnd is not None else "")
+            + (f"  phase {phase}" if phase else "  (starting)")
+            + "\n")
+    done = sum(1 for st in states.values() if st.terminal)
+    out.write(f"settled {done} request(s)\n")
+    slo_mod.render_slos(
+        slo_mod.evaluate_slos(states.values()), out)
+    flags = daemon_flags(states)
+    if flags:
+        for f in flags:
+            out.write(f"! {f}\n")
+    else:
+        out.write("anomalies: none\n")
+
+
+def _fleet_loop(queue_dir: str, interval: float,
+                max_frames: Optional[int]) -> int:
+    from gossipprotocol_tpu.serve import journal as journal_mod
+
+    if not os.path.isdir(queue_dir):
+        print(f"watch: {queue_dir!r} is not a directory", file=sys.stderr)
+        return 2
+    paths = journal_mod.QueuePaths(os.path.abspath(queue_dir))
+    out = sys.stdout
+    tty = out.isatty()
+    frames = 0
+    while True:
+        if tty:
+            out.write("\x1b[2J\x1b[H")
+        else:
+            out.write(f"--- frame {frames + 1} ---\n")
+        out.write(f"fleet {queue_dir}  [{time.strftime('%H:%M:%S')}]\n")
+        _fleet_frame(paths, out)
+        out.flush()
+        frames += 1
+        if max_frames is not None and frames >= max_frames:
+            return 0
+        time.sleep(interval)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print(
             "usage: python -m gossipprotocol_tpu watch TELEMETRY_DIR "
+            "[--interval S] [--max-frames N]\n"
+            "       python -m gossipprotocol_tpu watch --queue-dir D "
             "[--interval S] [--max-frames N]",
             file=sys.stderr if not argv else sys.stdout,
         )
         return 0 if argv else 2
     interval = INTERVAL_DEFAULT
     max_frames: Optional[int] = None
+    queue_dir: Optional[str] = None
     paths: List[str] = []
     i = 0
     while i < len(argv):
         a = argv[i]
-        if a in ("--interval", "--max-frames"):
+        if a in ("--interval", "--max-frames", "--queue-dir"):
             if i + 1 >= len(argv):
                 print(f"watch: {a} needs a value", file=sys.stderr)
                 return 2
             try:
                 if a == "--interval":
                     interval = max(0.05, float(argv[i + 1]))
-                else:
+                elif a == "--max-frames":
                     max_frames = int(argv[i + 1])
+                else:
+                    queue_dir = argv[i + 1]
             except ValueError:
                 print(f"watch: bad {a} {argv[i + 1]!r}", file=sys.stderr)
                 return 2
@@ -162,6 +244,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             paths.append(a)
             i += 1
+    if queue_dir is not None:
+        return _fleet_loop(queue_dir, interval, max_frames)
     if not paths:
         print("watch: missing TELEMETRY_DIR", file=sys.stderr)
         return 2

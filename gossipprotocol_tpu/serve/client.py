@@ -98,7 +98,8 @@ def _finish_code(st: journal_mod.RequestState, out) -> int:
     return _PHASE_RC.get(phase, 1)
 
 
-def _render_status(states: List[journal_mod.RequestState], out) -> None:
+def _render_status(states: List[journal_mod.RequestState], out,
+                   paths: Optional[journal_mod.QueuePaths] = None) -> None:
     for st in states:
         last = st.last
         line = f"{st.id}  {st.phase}"
@@ -109,10 +110,28 @@ def _render_status(states: List[journal_mod.RequestState], out) -> None:
                      f" rounds={last.get('rounds')}")
         elif st.phase in ("started", "batched"):
             line += f"  pid={last.get('pid')}" if last.get("pid") else ""
+            if paths is not None:
+                # live progress from the run's own telemetry: last
+                # published round + current phase span
+                prog = _live_progress(paths, st)
+                if prog is not None:
+                    if prog.get("round") is not None:
+                        line += f"  round={prog['round']}"
+                    if prog.get("phase"):
+                        line += f"  in={prog['phase']}"
         wait_s = st.queue_wait_s
         if wait_s is not None:
             line += f"  queue_wait={wait_s:.2f}s"
         print(line, file=out)
+
+
+def _live_progress(paths: journal_mod.QueuePaths,
+                   st: journal_mod.RequestState):
+    from gossipprotocol_tpu.serve import lifecycle as lifecycle_mod
+    try:
+        return lifecycle_mod.request_progress(paths, st)
+    except Exception:  # noqa: BLE001 — status must render regardless
+        return None
 
 
 def submit_main(argv: Optional[List[str]] = None) -> int:
@@ -211,11 +230,11 @@ def status_main(argv: Optional[List[str]] = None) -> int:
         if as_json:
             print(json.dumps(st.events, indent=2))
         else:
-            _render_status([st], sys.stdout)
+            _render_status([st], sys.stdout, paths=paths)
         return 0
     if as_json:
         print(json.dumps({s.id: s.events for s in states.values()},
                          indent=2))
     else:
-        _render_status(list(states.values()), sys.stdout)
+        _render_status(list(states.values()), sys.stdout, paths=paths)
     return 0

@@ -109,6 +109,10 @@ class Journal:
         self.paths = QueuePaths(os.path.abspath(queue_dir))
         self.paths.ensure()
         self._fh = None
+        # optional per-record hook (the metrics exporter's live feed);
+        # called AFTER the line is durable, so an observer crash can
+        # never lose a transition
+        self.observer = None
 
     def append(self, event: str, request_id: str, **fields: Any) -> Dict:
         rec = {"v": SCHEMA_VERSION, "ts": round(time.time(), 3),
@@ -117,6 +121,8 @@ class Journal:
         if self._fh is None:
             self._fh = open(self.paths.journal, "a", buffering=1)
         self._fh.write(json.dumps(rec) + "\n")
+        if self.observer is not None:
+            self.observer(rec)
         return rec
 
     def close(self) -> None:
@@ -186,6 +192,31 @@ class RequestState:
         if end is None:
             return None
         return round(max(0.0, end["ts"] - acc["ts"]), 3)
+
+    @property
+    def admission_latency_s(self) -> Optional[float]:
+        """Seconds between acceptance and the admission verdict."""
+        acc = self.first("accepted")
+        if acc is None:
+            return None
+        end = self.first("admitted") or self.first("refused")
+        if end is None:
+            return None
+        return round(max(0.0, end["ts"] - acc["ts"]), 3)
+
+    @property
+    def run_wall_s(self) -> Optional[float]:
+        """Seconds between first worker start and the terminal event."""
+        start = self.first("started") or self.first("batched")
+        if start is None or not self.terminal:
+            return None
+        return round(max(0.0, self.last["ts"] - start["ts"]), 3)
+
+    @property
+    def retries(self) -> int:
+        """Infra-failure retry events consumed by this request."""
+        return sum(1 for rec in self.events
+                   if rec.get("event") == "retry")
 
     @property
     def verdict(self) -> Optional[str]:

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional
 
 from gossipprotocol_tpu.serve import admission as adm_mod
 from gossipprotocol_tpu.serve import journal as journal_mod
+from gossipprotocol_tpu.serve import lifecycle as lifecycle_mod
 from gossipprotocol_tpu.utils.metrics import SCHEMA_VERSION
 
 MSG_QUEUE_FULL = ("queue full: {depth} requests pending (max {max_queue}) "
@@ -102,6 +103,15 @@ class Supervisor:
         self.running: Dict[str, _Running] = {}
         self._stop = False
         self._httpd = None
+        # /metrics registry: re-derived from the journal (so monotonic
+        # counters survive SIGKILL bitwise), then fed live — the
+        # observer hook folds every appended record through the same
+        # code path the replay used
+        from gossipprotocol_tpu.obs import exporter as exporter_mod
+
+        self.metrics = exporter_mod.FleetMetrics.from_records(
+            self.journal.records())
+        self.journal.observer = self.metrics.observe
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -170,6 +180,10 @@ class Supervisor:
             st.id, "interrupted",
             "daemon died mid-run with no checkpoint to resume",
             tel_dir=started.get("telemetry_dir"))
+        self._stamp_lifecycle(
+            [st.id],
+            started.get("telemetry_dir")
+            or self.paths.telemetry_dir(st.id))
 
     def _requeue_resumable(self, st, what: str,
                            resume_round: Optional[int] = None) -> None:
@@ -266,9 +280,15 @@ class Supervisor:
             self.journal.append("refused", rid, reason=decision.reason)
             self._log(f"{rid} refused: {decision.reason}")
             return
+        # the admission-time prediction rides into the journal so the
+        # SLO prediction-ratio indicator (obs/slo.py) and the blowout
+        # anomaly rule need nothing but a replay
+        pred = (decision.verdict_doc.get("prediction") or {})
         self.journal.append("admitted", rid,
                             round_budget=doc.get("round_budget"),
-                            wall_budget_s=doc.get("wall_budget_s"))
+                            wall_budget_s=doc.get("wall_budget_s"),
+                            predicted_rounds=pred.get("predicted_rounds"),
+                            prediction_confidence=pred.get("confidence"))
         self.pending.append(_Pending(rid, doc, args=decision.args))
 
     # ------------------------------------------------------------------
@@ -427,9 +447,37 @@ class Supervisor:
             self.journal.append("timeout", rid, reason=reason)
             self._stamp_outcome(rid, "timeout", reason,
                                 tel_dir=run.tel_dir)
+        self._stamp_lifecycle(run.ids, run.tel_dir)
         self._log(f"{run_id} timed out: {reason}")
 
+    def render_metrics(self) -> str:
+        """Prometheus text exposition of the fleet registry, with the
+        live gauges refreshed from in-memory state. Counters come from
+        the journal fold (see FleetMetrics) so they survive SIGKILL."""
+        self.metrics.set_live(
+            queue_depth=len(self.pending) + len(self.running),
+            workers_active=len(self.running),
+            workers_max=self.max_workers,
+            queue_max=self.max_queue)
+        return self.metrics.render()
+
+    def _stamp_lifecycle(self, ids: List[str], tel_dir: str) -> None:
+        """Merge the requests' journal lifecycle spans into the run's
+        trace.json (daemon track above the run's own phases) and stamp
+        the summary into run.json. Never fatal — tracing a settled run
+        must not take the daemon down."""
+        try:
+            states = journal_mod.replay(self.journal.records())
+            lifecycle_mod.merge_lifecycle(
+                tel_dir, [states[i] for i in ids if i in states])
+        except Exception as e:  # noqa: BLE001
+            self._log(f"lifecycle stamp failed for {ids}: {e}")
+
     def _settle(self, run_id: str, run: _Running, rc: int) -> None:
+        self._do_settle(run_id, run, rc)
+        self._stamp_lifecycle(run.ids, run.tel_dir)
+
+    def _do_settle(self, run_id: str, run: _Running, rc: int) -> None:
         if rc in (0, 1):
             self._settle_finished(run_id, run)
         elif rc == 3:
@@ -564,6 +612,7 @@ class Supervisor:
                 self.journal.append("interrupted", rid, reason=reason)
                 self._stamp_outcome(rid, "interrupted", reason,
                                     tel_dir=run.tel_dir)
+            self._stamp_lifecycle(run.ids, run.tel_dir)
             self._log(f"{run_id}: {reason}")
         self._log("drain complete")
 
@@ -617,6 +666,16 @@ class Supervisor:
                                       "pending": len(sup.pending),
                                       "running": len(sup.running)})
                     return
+                if self.path == "/metrics":
+                    body = sup.render_metrics().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path.startswith("/status/"):
                     rid = self.path[len("/status/"):]
                     states = journal_mod.replay(sup.journal.records())
@@ -630,7 +689,11 @@ class Supervisor:
                         "id": rid, "phase": st.phase,
                         "verdict": st.verdict,
                         "queue_wait_s": st.queue_wait_s,
-                        "last": st.last})
+                        "last": st.last,
+                        # live progress, not just journal state: what
+                        # the worker has published so far
+                        "progress": lifecycle_mod.request_progress(
+                            sup.paths, st)})
                     return
                 self._reply(404, {"error": "not found"})
 
@@ -696,8 +759,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="queue directory (created if absent); the "
                         "daemon's whole durable state lives here")
     p.add_argument("--http", type=int, default=None, metavar="PORT",
-                   help="also serve /healthz, /submit, /status/<id> on "
-                        "127.0.0.1:PORT (0 picks a free port)")
+                   help="also serve /healthz, /submit, /status/<id>, "
+                        "and Prometheus /metrics on 127.0.0.1:PORT "
+                        "(0 picks a free port)")
     p.add_argument("--poll", type=float, default=0.2, metavar="S",
                    help="queue/worker poll interval (default 0.2s)")
     p.add_argument("--max-queue", type=int, default=64, metavar="N",

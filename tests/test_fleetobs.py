@@ -1,0 +1,671 @@
+"""Fleet observatory tests: the zero-dep Prometheus exporter (golden
+text exposition, strict parser, histogram consistency, bitwise counter
+preservation across SIGKILL + journal replay), request-lifecycle spans
+merged into the run's Perfetto trace, SLO burn-rate math, the pinned
+daemon anomaly rules, the fleet ``watch --queue-dir`` frame, live
+``/status`` progress, and run-index dedupe."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from gossipprotocol_tpu.obs import anomaly
+from gossipprotocol_tpu.obs import exporter
+from gossipprotocol_tpu.obs import slo as slo_mod
+from gossipprotocol_tpu.serve import client
+from gossipprotocol_tpu.serve import journal as journal_mod
+from gossipprotocol_tpu.serve import lifecycle
+from gossipprotocol_tpu.serve.supervisor import MSG_QUEUE_FULL
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+T0 = 1_700_000_000.0  # fixed epoch for synthetic journals
+
+
+def _rec(event, rid, ts, **fields):
+    rec = {"v": 1, "ts": round(ts, 3), "event": event, "request_id": rid}
+    rec.update(fields)
+    return rec
+
+
+def _healthy_records(rid="req-ok", t0=T0):
+    return [
+        _rec("accepted", rid, t0),
+        _rec("admitted", rid, t0 + 0.1),
+        _rec("started", rid, t0 + 0.5, pid=123),
+        _rec("finished", rid, t0 + 3.0, converged=True, rounds=25),
+    ]
+
+
+# ---------------------------------------------------------------------
+# exporter: registry + golden exposition
+
+
+def test_refusal_reason_class():
+    assert exporter.refusal_reason_class(
+        MSG_QUEUE_FULL.format(depth=4, max_queue=4)) == "queue_full"
+    assert exporter.refusal_reason_class(
+        "over budget: predicted 100 rounds") == "over_budget"
+    assert exporter.refusal_reason_class(
+        "needs 2 GiB but exceeds 90% of device capacity") == "capacity"
+    assert exporter.refusal_reason_class("request invalid: x") == "invalid"
+    assert exporter.refusal_reason_class("request unreadable: x") == "invalid"
+    assert exporter.refusal_reason_class("mystery") == "other"
+    assert exporter.refusal_reason_class("") == "other"
+
+
+def test_exporter_golden_exposition():
+    """The /metrics body parses with the strict zero-dep parser, the
+    pinned CI metric name is present, and values match the journal."""
+    records = (_healthy_records("req-a")
+               + _healthy_records("req-b", T0 + 10)
+               + [_rec("accepted", "req-c", T0 + 20),
+                  _rec("refused", "req-c", T0 + 20.2,
+                       reason=MSG_QUEUE_FULL.format(depth=9, max_queue=8))])
+    m = exporter.FleetMetrics.from_records(records)
+    m.set_live(queue_depth=0, workers_active=0, workers_max=4, queue_max=8)
+    text = m.render()
+    assert text.endswith("\n") and "\n\n" not in text
+    # the pinned line CI greps for, byte-exact
+    assert "\ngossip_requests_admitted_total 2\n" in text
+
+    fams = exporter.parse_text_exposition(text)
+    assert fams["gossip_requests_accepted_total"]["type"] == "counter"
+    assert fams["gossip_requests_accepted_total"]["samples"] == [
+        ("gossip_requests_accepted_total", {}, 3.0)]
+    assert fams["gossip_requests_admitted_total"]["samples"] == [
+        ("gossip_requests_admitted_total", {}, 2.0)]
+    assert fams["gossip_requests_refused_total"]["samples"] == [
+        ("gossip_requests_refused_total", {"reason": "queue_full"}, 1.0)]
+    assert fams["gossip_requests_outcome_total"]["samples"] == [
+        ("gossip_requests_outcome_total", {"outcome": "finished"}, 2.0)]
+    assert fams["gossip_queue_max"]["type"] == "gauge"
+    assert fams["gossip_queue_max"]["samples"][0][2] == 8.0
+    # histograms: internally consistent, totals match the journal
+    for name in ("gossip_request_queue_wait_seconds",
+                 "gossip_request_run_wall_seconds"):
+        fam = fams[name]
+        assert fam["type"] == "histogram"
+        exporter.check_histogram_consistency(name, fam)
+    wait = fams["gossip_request_queue_wait_seconds"]["samples"]
+    # 3 waits observed (2 starts + 1 refusal), sum 0.5+0.5+0.2
+    assert ("gossip_request_queue_wait_seconds_count", {}, 3.0) in wait
+    assert ("gossip_request_queue_wait_seconds_sum", {}, 1.2) in wait
+    run = fams["gossip_request_run_wall_seconds"]["samples"]
+    assert ("gossip_request_run_wall_seconds_count", {}, 2.0) in run
+    assert ("gossip_request_run_wall_seconds_sum", {}, 5.0) in run
+
+
+def test_exporter_parser_strict():
+    parse = exporter.parse_text_exposition
+    with pytest.raises(ValueError, match="blank line"):
+        parse("# HELP a b\n# TYPE a counter\n\na 1\n")
+    with pytest.raises(ValueError, match="no declared family"):
+        parse("undeclared_metric 1\n")
+    with pytest.raises(ValueError, match="bad TYPE"):
+        parse("# TYPE a wibble\n")
+    with pytest.raises(ValueError, match="unexpected comment"):
+        parse("# EOF\n")
+    with pytest.raises(ValueError, match="unparseable labels"):
+        parse('# TYPE a counter\na{x=unquoted} 1\n')
+    # well-formed label escapes round-trip
+    fams = parse('# TYPE a counter\na{x="q\\"uo\\\\te"} 2\n')
+    assert fams["a"]["samples"] == [("a", {"x": 'q"uo\\te'}, 2.0)]
+
+
+def test_exporter_histogram_internal_consistency():
+    h = exporter.Histogram("h_seconds", "help.", (1.0, 5.0, 10.0))
+    for v in (0.2, 0.9, 3.0, 7.0, 100.0):
+        h.observe(v)
+    fams = exporter.parse_text_exposition(
+        "\n".join(h.render()) + "\n")
+    exporter.check_histogram_consistency("h_seconds", fams["h_seconds"])
+    samples = {(n, labels.get("le")): v
+               for n, labels, v in fams["h_seconds"]["samples"]}
+    assert samples[("h_seconds_bucket", "1")] == 2
+    assert samples[("h_seconds_bucket", "5")] == 3
+    assert samples[("h_seconds_bucket", "10")] == 4
+    assert samples[("h_seconds_bucket", "+Inf")] == 5
+    assert samples[("h_seconds_count", None)] == 5
+    assert samples[("h_seconds_sum", None)] == pytest.approx(111.1)
+    # corrupted exposition is rejected: +Inf bucket != _count
+    bad = ("# TYPE b histogram\n"
+           'b_bucket{le="1"} 2\nb_bucket{le="+Inf"} 2\n'
+           "b_sum 3\nb_count 5\n")
+    with pytest.raises(ValueError, match="!= _count"):
+        exporter.check_histogram_consistency(
+            "b", exporter.parse_text_exposition(bad)["b"])
+
+
+def test_exporter_bitwise_incremental_vs_replay():
+    """The live fold (observer hook) and the restart fold (from_records)
+    must render byte-identical bodies — that is the SIGKILL story."""
+    records = (_healthy_records("req-a")
+               + [_rec("accepted", "req-r", T0 + 5),
+                  _rec("admitted", "req-r", T0 + 5.1),
+                  _rec("started", "req-r", T0 + 6, pid=7),
+                  _rec("retry", "req-r", T0 + 8, backoff_s=1.0, attempt=1),
+                  _rec("started", "req-r", T0 + 9.5, pid=8),
+                  _rec("failed", "req-r", T0 + 12, reason="boom")]
+               + [_rec("accepted", "req-b1", T0 + 20),
+                  _rec("admitted", "req-b1", T0 + 20.1),
+                  _rec("accepted", "req-b2", T0 + 20.2),
+                  _rec("admitted", "req-b2", T0 + 20.3),
+                  _rec("batched", "req-b1", T0 + 21, batch="b-1", lane=0),
+                  _rec("batched", "req-b2", T0 + 21, batch="b-1", lane=1),
+                  _rec("finished", "req-b1", T0 + 25, rounds=10),
+                  _rec("finished", "req-b2", T0 + 25, rounds=12)])
+    live = exporter.FleetMetrics()
+    for rec in records:
+        live.observe(rec)
+    replayed = exporter.FleetMetrics.from_records(records)
+    assert live.render() == replayed.render()
+    # spot-check the retry/sweep families made it in
+    fams = exporter.parse_text_exposition(live.render())
+    assert fams["gossip_infra_retries_total"]["samples"][0][2] == 1.0
+    assert fams["gossip_retry_backoff_seconds_total"]["samples"][0][2] == 1.0
+    assert fams["gossip_sweep_batches_total"]["samples"][0][2] == 1.0
+    assert fams["gossip_sweep_batch_lanes_total"]["samples"][0][2] == 2.0
+    # run-wall histogram: req-a + the failed single + two batch lanes
+    assert ("gossip_request_run_wall_seconds_count", {}, 4.0) \
+        in fams["gossip_request_run_wall_seconds"]["samples"]
+
+
+# ---------------------------------------------------------------------
+# SLOs
+
+
+def _states(records):
+    return journal_mod.replay(records)
+
+
+def test_slo_burn_math():
+    records = (
+        # r1: admission 0.1s, wait 0.5s, ratio 200/100 = 2.0 -> all good
+        [_rec("accepted", "r1", T0),
+         _rec("admitted", "r1", T0 + 0.1, predicted_rounds=100,
+              prediction_confidence="analytic"),
+         _rec("started", "r1", T0 + 0.5),
+         _rec("finished", "r1", T0 + 2, rounds=200)]
+        # r2: admission 5s (bad), wait 40s (bad), no prediction
+        + [_rec("accepted", "r2", T0),
+           _rec("admitted", "r2", T0 + 5),
+           _rec("started", "r2", T0 + 40),
+           _rec("finished", "r2", T0 + 41, rounds=9)]
+        # r3: still queued -> unmeasurable everywhere, never counted bad
+        + [_rec("accepted", "r3", T0 + 100)])
+    statuses = {s.spec.name: s
+                for s in slo_mod.evaluate_slos(_states(records).values())}
+    adm = statuses["admission_latency"]
+    assert (adm.good, adm.bad) == (1, 1)
+    assert adm.burn_rate == pytest.approx(50.0)  # 0.5 / 0.01
+    assert adm.breached
+    qw = statuses["queue_wait"]
+    assert (qw.good, qw.bad) == (1, 1)
+    assert qw.burn_rate == pytest.approx(10.0)  # 0.5 / 0.05
+    pr = statuses["prediction_ratio"]
+    assert (pr.good, pr.bad) == (1, 0)
+    assert pr.burn_rate == 0.0 and not pr.breached
+
+    import io
+    buf = io.StringIO()
+    slo_mod.render_slos(list(statuses.values()), buf)
+    lines = buf.getvalue().splitlines()
+    assert any(l.startswith("slo admission_latency") and "BREACHED" in l
+               for l in lines)
+    assert any(l.startswith("slo prediction_ratio") and "burn 0.00x" in l
+               and "BREACHED" not in l for l in lines)
+    doc = slo_mod.slo_doc(list(statuses.values()))
+    assert {d["name"]: d["breached"] for d in doc} == {
+        "admission_latency": True, "queue_wait": True,
+        "prediction_ratio": False}
+
+
+def test_prediction_ratio_unmeasurable_cases():
+    # no admitted event
+    assert slo_mod.prediction_ratio(
+        _states([_rec("accepted", "x", T0)])["x"]) is None
+    # admitted but no stamped prediction (pre-observatory journal)
+    assert slo_mod.prediction_ratio(_states(
+        [_rec("admitted", "x", T0),
+         _rec("finished", "x", T0 + 1, rounds=5)])["x"]) is None
+    # over_budget counts as a final rounds source
+    st = _states([_rec("admitted", "x", T0, predicted_rounds=10),
+                  _rec("over_budget", "x", T0 + 1, rounds=80)])["x"]
+    assert slo_mod.prediction_ratio(st) == 8.0
+    with pytest.raises(ValueError, match="unknown SLO indicator"):
+        slo_mod.indicator_value(st, "nope")
+
+
+# ---------------------------------------------------------------------
+# daemon anomaly rules (pinned messages)
+
+
+def test_daemon_flags_healthy_is_empty():
+    assert anomaly.daemon_flags(_states(_healthy_records())) == []
+
+
+def test_daemon_flags_queue_saturation_pinned():
+    records = _healthy_records() + [
+        _rec("accepted", "req-x", T0 + 5),
+        _rec("refused", "req-x", T0 + 5.1,
+             reason=MSG_QUEUE_FULL.format(depth=8, max_queue=8)),
+    ]
+    flags = anomaly.daemon_flags(_states(records))
+    assert flags == [anomaly.MSG_QUEUE_SATURATED.format(n=1)]
+    assert flags[0].startswith("queue SATURATED: 1 request(s)")
+    # a non-queue refusal does not trip it
+    records[-1] = _rec("refused", "req-x", T0 + 5.1,
+                       reason="request invalid: nope")
+    assert anomaly.daemon_flags(_states(records)) == []
+
+
+def test_daemon_flags_retry_storm():
+    records = _healthy_records()
+    for i in range(anomaly.RETRY_STORM_MIN):
+        records.append(_rec("retry", "req-ok", T0 + 10 + i, backoff_s=1.0))
+    flags = anomaly.daemon_flags(_states(records))
+    assert flags == [anomaly.MSG_RETRY_STORM.format(
+        n=anomaly.RETRY_STORM_MIN, m=1)]
+    # one fewer retry stays silent
+    assert anomaly.daemon_flags(_states(records[:-1])) == []
+
+
+def test_daemon_flags_prediction_blowout_analytic_only():
+    def fixture(confidence, rounds):
+        return [_rec("accepted", "r", T0),
+                _rec("admitted", "r", T0 + 0.1, predicted_rounds=10,
+                     prediction_confidence=confidence),
+                _rec("started", "r", T0 + 1),
+                _rec("finished", "r", T0 + 2, rounds=rounds)]
+    flags = anomaly.daemon_flags(_states(fixture("analytic", 100)))
+    assert flags == [anomaly.MSG_PREDICTION_BLOWOUT.format(
+        rid="r", rounds=100, ratio=10.0, predicted=10)]
+    # heuristic predictions never fire (same gating as the run rule)
+    assert anomaly.daemon_flags(_states(fixture("heuristic", 100))) == []
+    # within the factor is healthy
+    assert anomaly.daemon_flags(_states(fixture("analytic", 79))) == []
+
+
+# ---------------------------------------------------------------------
+# lifecycle spans -> Perfetto merge (the acceptance-criteria trace)
+
+
+def test_lifecycle_merge_into_run_trace(tmp_path, capsys):
+    """One trace.json holds, for the same request id, the daemon's
+    lifecycle spans (pid 2) above the run's own depth-0 phase spans
+    (pid 1) — the structural form of the Perfetto acceptance check."""
+    from gossipprotocol_tpu.cli import main as cli_main
+    from gossipprotocol_tpu.obs.telemetry import (
+        TRACE_PID_DAEMON, TRACE_PID_RUN,
+    )
+
+    tel = str(tmp_path / "tel")
+    rid = "req-perfetto1"
+    assert cli_main(["64", "full", "gossip", "--seed", "1",
+                     "--telemetry-dir", tel]) == 0
+    capsys.readouterr()
+    epoch = lifecycle.read_epoch0(tel)
+    assert isinstance(epoch, float)
+    records = [
+        _rec("accepted", rid, epoch - 1.5),
+        _rec("admitted", rid, epoch - 1.0, predicted_rounds=40),
+        _rec("started", rid, epoch - 0.2, pid=999, telemetry_dir=tel),
+        _rec("finished", rid, epoch + 1.0, converged=True, rounds=25),
+    ]
+    states = list(journal_mod.replay(records).values())
+    path = lifecycle.merge_lifecycle(tel, states)
+    assert path == os.path.join(tel, "trace.json")
+    doc = json.loads(open(path).read())
+    events = doc["traceEvents"]
+
+    run_spans = {e["name"] for e in events
+                 if e.get("pid") == TRACE_PID_RUN and e.get("ph") == "X"
+                 and e.get("tid") == 1}  # tid 1 == depth 0
+    assert "topology_build" in run_spans and "chunk" in run_spans
+    daemon_evs = [e for e in events if e.get("pid") == TRACE_PID_DAEMON]
+    spans = {e["name"]: e for e in daemon_evs if e.get("ph") == "X"}
+    assert set(spans) == {"accepted", "admitted", "started"}
+    # anchored on the run's epoch: pre-start events sit at negative ts
+    assert spans["accepted"]["ts"] < 0
+    assert spans["accepted"]["dur"] == pytest.approx(0.5e6)
+    [instant] = [e for e in daemon_evs if e.get("ph") == "i"]
+    assert instant["name"] == "finished"
+    meta = {e["name"]: e["args"]["name"] for e in daemon_evs
+            if e.get("ph") == "M"}
+    assert meta["process_name"] == "serve daemon"
+    assert meta["thread_name"] == f"request {rid}"
+
+    # idempotent: re-merging replaces the daemon track, never doubles it
+    before = len(daemon_evs)
+    lifecycle.merge_lifecycle(tel, states)
+    doc2 = json.loads(open(path).read())
+    assert len([e for e in doc2["traceEvents"]
+                if e.get("pid") == TRACE_PID_DAEMON]) == before
+
+    # the manifest got the compact summary, and report renders it
+    manifest = json.loads(open(os.path.join(tel, "run.json")).read())
+    [lc] = manifest["lifecycle"]
+    assert lc["request_id"] == rid and lc["outcome"] == "finished"
+    assert [p["phase"] for p in lc["phases"]] == [
+        "accepted", "admitted", "started"]
+    from gossipprotocol_tpu.obs.report import main as report_main
+    assert report_main([tel]) == 0
+    out = capsys.readouterr().out
+    assert f"lifecycle: {rid}" in out and "-> finished" in out
+
+
+def test_run_progress_and_status_render(tmp_path, capsys):
+    q = tmp_path / "q"
+    j = journal_mod.Journal(str(q))
+    tel = os.path.join(str(q), "runs", "req-live", "telemetry")
+    os.makedirs(tel)
+    with open(os.path.join(tel, "events.jsonl"), "w") as fh:
+        fh.write(json.dumps({"kind": "start", "epoch_s": T0}) + "\n")
+        fh.write(json.dumps({"kind": "span", "name": "topology_build",
+                             "dur_s": 0.1}) + "\n")
+        fh.write(json.dumps({"kind": "span", "name": "chunk",
+                             "dur_s": 0.2}) + "\n")
+        fh.write(json.dumps({"kind": "metric",
+                             "rec": {"round": 12, "alive": 64}}) + "\n")
+    prog = lifecycle.run_progress(tel)
+    assert prog == {"round": 12, "phase": "chunk", "finished": False,
+                    "telemetry_dir": tel}
+    assert lifecycle.run_progress(str(tmp_path / "nope")) is None
+
+    j.append("accepted", "req-live")
+    j.append("admitted", "req-live")
+    j.append("started", "req-live", pid=1, telemetry_dir=tel)
+    j.close()
+    assert client.status_main(["--queue-dir", str(q)]) == 0
+    out = capsys.readouterr().out
+    assert "req-live  started" in out
+    assert "round=12" in out and "in=chunk" in out
+
+
+# ---------------------------------------------------------------------
+# fleet watch
+
+
+def test_watch_fleet_frame(tmp_path, capsys):
+    from gossipprotocol_tpu.obs.watch import main as watch_main
+
+    q = tmp_path / "q"
+    j = journal_mod.Journal(str(q))
+    for rec in _healthy_records("req-done"):
+        j.append(rec["event"], rec["request_id"],
+                 **{k: v for k, v in rec.items()
+                    if k not in ("v", "ts", "event", "request_id")})
+    tel = os.path.join(str(q), "runs", "req-run", "telemetry")
+    os.makedirs(tel)
+    with open(os.path.join(tel, "events.jsonl"), "w") as fh:
+        fh.write(json.dumps({"kind": "start", "epoch_s": T0}) + "\n")
+        fh.write(json.dumps({"kind": "metric", "rec": {"round": 7}}) + "\n")
+    j.append("accepted", "req-run")
+    j.append("admitted", "req-run")
+    j.append("started", "req-run", pid=2, telemetry_dir=tel)
+    j.append("accepted", "req-q")
+    j.append("admitted", "req-q")
+    j.close()
+    assert watch_main(["--queue-dir", str(q), "--max-frames", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "queue depth 2 (1 running, 1 pending)" in out
+    assert "worker  req-run  round 7" in out
+    assert "settled 1 request(s)" in out
+    assert "slo queue_wait" in out
+    assert "anomalies: none" in out
+
+    # saturate the queue: the frame must carry the pinned anomaly
+    j2 = journal_mod.Journal(str(q))
+    j2.append("accepted", "req-sat")
+    j2.append("refused", "req-sat",
+              reason=MSG_QUEUE_FULL.format(depth=8, max_queue=8))
+    j2.close()
+    assert watch_main(["--queue-dir", str(q), "--max-frames", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "! " + anomaly.MSG_QUEUE_SATURATED.format(n=1) in out
+
+    assert watch_main(["--queue-dir", str(tmp_path / "absent")]) == 2
+
+
+# ---------------------------------------------------------------------
+# run-index dedupe
+
+
+def test_history_dedupes_symlinked_dirs(tmp_path):
+    from gossipprotocol_tpu.obs.history import INDEX_RELPATH, build_index
+
+    root = str(tmp_path)
+    real = tmp_path / "artifacts" / "real_tel"
+    real.mkdir(parents=True)
+    (real / "run.json").write_text(json.dumps({
+        "kind": "run_manifest", "request_id": "req-idx",
+        "config": {"algorithm": "gossip"},
+        "topology": {"kind": "full", "num_nodes": 64},
+        "result": {"converged": True, "rounds": 9, "wall_ms": 1.0}}))
+    os.symlink(str(real), str(tmp_path / "artifacts" / "alias_tel"))
+
+    # a queue journal reachable via two glob patterns must index once
+    j = journal_mod.Journal(os.path.join(root, "artifacts", "q"))
+    j.append("accepted", "req-j")
+    j.append("refused", "req-j", reason="request invalid: x")
+    j.close()
+    os.symlink(os.path.join(root, "artifacts", "q"),
+               os.path.join(root, "qlink"))
+
+    records = build_index(root, write=True)
+    runs = [r for r in records if r["kind"] == "run"]
+    assert len(runs) == 1
+    assert runs[0]["request_id"] == "req-idx"
+    reqs = [r for r in records if r["kind"] == "request"]
+    assert len(reqs) == 1
+    # a rebuild over its own output stays stable (the index itself is
+    # rewritten whole, never re-ingested)
+    again = build_index(root, write=True)
+    assert len([r for r in again if r["kind"] == "run"]) == 1
+    lines = open(os.path.join(root, INDEX_RELPATH)).read().splitlines()
+    assert len(lines) == len(again)
+
+
+def test_history_picks_up_bench_infra_stamp(tmp_path, capsys):
+    from gossipprotocol_tpu.obs.history import build_index, render_history
+
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "rc": 0, "parsed": {
+            "metric": "gossip_imp3d_1M_nodes_time_to_convergence",
+            "value": 30.0, "unit": "s", "rounds": 40, "backend": "cpu",
+            "infra_failure": False, "probe_attempts": 3,
+            "gossip_infra_retries_total": 2,
+            "gossip_retry_backoff_seconds_total": 3.0,
+            "infra_outcome": "ok"}}))
+    records = build_index(str(tmp_path), write=False)
+    [bench] = records
+    assert bench["gossip_infra_retries_total"] == 2
+    assert bench["gossip_retry_backoff_seconds_total"] == 3.0
+    assert bench["infra_outcome"] == "ok"
+    import io
+    buf = io.StringIO()
+    render_history(records, buf)
+    assert "infra-retries 2" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------
+# daemon HTTP integration: /metrics across SIGKILL + journal replay
+
+
+def _start_daemon(queue_dir, *extra, env_extra=None):
+    env = os.environ.copy()
+    env.update(env_extra or {})
+    os.makedirs(str(queue_dir), exist_ok=True)
+    log = open(os.path.join(str(queue_dir), "daemon.log"), "a")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gossipprotocol_tpu", "serve",
+         "--queue-dir", str(queue_dir), "--poll", "0.05",
+         "--drain-grace", "60", *extra],
+        cwd=REPO, stdout=log, stderr=subprocess.STDOUT, env=env,
+        start_new_session=True)
+    proc._log_fh = log
+    return proc
+
+
+def _stop_daemon(proc, timeout=90):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=timeout)
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+        proc._log_fh.close()
+    return rc
+
+
+def _wait_phase(queue_dir, rid, phases, timeout=150):
+    deadline = time.monotonic() + timeout
+    p = None
+    while time.monotonic() < deadline:
+        st = client.request_state(str(queue_dir), rid)
+        p = st.phase if st is not None else "submitted"
+        if p in phases:
+            return p
+        time.sleep(0.1)
+    raise AssertionError(f"{rid} never reached {phases} (stuck: {p!r})")
+
+
+def _http_port(queue_dir, seen=0, timeout=60):
+    """Port from the daemon.log banner; ``seen`` skips banners from
+    earlier daemon incarnations on the same (appended) log."""
+    log = os.path.join(str(queue_dir), "daemon.log")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            ports = [int(line.rsplit(":", 1)[1])
+                     for line in open(log).read().splitlines()
+                     if "http on 127.0.0.1:" in line]
+        except OSError:
+            ports = []
+        if len(ports) > seen:
+            return ports[seen]
+        time.sleep(0.1)
+    raise AssertionError("daemon never reported its http port")
+
+
+def _scrape(port, path="/metrics"):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.headers.get("Content-Type"), resp.read().decode()
+
+
+def _counter_samples(text):
+    """Counter + histogram samples only — gauges are live state and
+    legitimately differ across a restart."""
+    fams = exporter.parse_text_exposition(text)
+    out = {}
+    for name, fam in fams.items():
+        if fam["type"] in ("counter", "histogram"):
+            out[name] = sorted(
+                (n, tuple(sorted(labels.items())), v)
+                for n, labels, v in fam["samples"])
+    return out
+
+
+def test_daemon_metrics_survive_sigkill(tmp_path):
+    """Scrape /metrics, SIGKILL the daemon, restart it on the same queue
+    dir: every monotonic counter and histogram renders bitwise-identical
+    values, re-derived from the journal."""
+    q = tmp_path / "q"
+    env = {"GOSSIP_TPU_HBM_BYTES": str(64 * 1024 * 1024)}
+    proc = _start_daemon(q, "--http", "0", env_extra=env)
+    try:
+        port = _http_port(q)
+        ok = client.submit(str(q), {"argv": ["64", "full", "gossip",
+                                             "--seed", "7"],
+                                    "round_budget": 500})
+        big = client.submit(str(q),
+                            {"argv": ["5000000", "line", "gossip"]})
+        assert _wait_phase(q, big, {"refused"}) == "refused"
+        assert _wait_phase(q, ok, {"finished"}) == "finished"
+
+        ctype, text = _scrape(port)
+        assert ctype.startswith("text/plain; version=0.0.4")
+        before = _counter_samples(text)
+        fams = exporter.parse_text_exposition(text)
+        assert fams["gossip_requests_admitted_total"]["samples"] == [
+            ("gossip_requests_admitted_total", {}, 1.0)]
+        assert fams["gossip_requests_refused_total"]["samples"] == [
+            ("gossip_requests_refused_total", {"reason": "capacity"}, 1.0)]
+        for name in ("gossip_request_queue_wait_seconds",
+                     "gossip_request_run_wall_seconds"):
+            exporter.check_histogram_consistency(name, fams[name])
+
+        # /status/<id> carries live progress for the finished worker
+        _, status = _scrape(port, f"/status/{ok}")
+        doc = json.loads(status)
+        assert doc["phase"] == "finished"
+        assert doc["progress"]["finished"] is True
+        assert doc["progress"]["telemetry_dir"]
+    finally:
+        os.killpg(proc.pid, signal.SIGKILL)  # machine crash, in effect
+        proc.wait()
+        proc._log_fh.close()
+
+    proc = _start_daemon(q, "--http", "0", env_extra=env)
+    try:
+        port = _http_port(q, seen=1)
+        _, text = _scrape(port)
+        assert _counter_samples(text) == before
+    finally:
+        rc = _stop_daemon(proc)
+    assert rc == 0
+
+
+def test_daemon_stamps_lifecycle_trace(tmp_path):
+    """End-to-end: a daemon-settled request's telemetry dir holds ONE
+    trace.json with both the run's pid-1 spans and the daemon's pid-2
+    lifecycle track for that request id."""
+    from gossipprotocol_tpu.obs.telemetry import (
+        TRACE_PID_DAEMON, TRACE_PID_RUN,
+    )
+
+    q = tmp_path / "q"
+    proc = _start_daemon(q)
+    try:
+        ok = client.submit(str(q), {"argv": ["64", "full", "gossip"]})
+        assert _wait_phase(q, ok, {"finished"}) == "finished"
+        paths = journal_mod.QueuePaths(str(q))
+        trace_path = os.path.join(paths.telemetry_dir(ok), "trace.json")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                events = json.loads(
+                    open(trace_path).read())["traceEvents"]
+                if any(e.get("pid") == TRACE_PID_DAEMON for e in events):
+                    break
+            except (OSError, json.JSONDecodeError, KeyError):
+                pass
+            time.sleep(0.2)
+        else:
+            raise AssertionError("daemon track never landed in trace.json")
+        assert any(e.get("pid") == TRACE_PID_RUN
+                   and e.get("name") == "chunk" for e in events)
+        daemon_names = {e["name"] for e in events
+                        if e.get("pid") == TRACE_PID_DAEMON
+                        and e.get("ph") in ("X", "i")}
+        assert {"accepted", "admitted", "started", "finished"} \
+            <= daemon_names
+        assert any(e.get("ph") == "M" and e.get("name") == "thread_name"
+                   and e["args"]["name"] == f"request {ok}"
+                   for e in events if e.get("pid") == TRACE_PID_DAEMON)
+        manifest = json.loads(open(os.path.join(
+            paths.telemetry_dir(ok), "run.json")).read())
+        assert manifest["lifecycle"][0]["request_id"] == ok
+    finally:
+        rc = _stop_daemon(proc)
+    assert rc == 0
